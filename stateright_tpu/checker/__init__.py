@@ -1,0 +1,16 @@
+"""Checker engine layer (reference L2, ``src/checker.rs`` + ``src/checker/``)."""
+
+from .base import Checker, CheckerBuilder, JOB_BLOCK_SIZE
+from .path import Path
+from .visitor import CheckerVisitor, FnVisitor, PathRecorder, StateRecorder
+
+__all__ = [
+    "Checker",
+    "CheckerBuilder",
+    "JOB_BLOCK_SIZE",
+    "Path",
+    "CheckerVisitor",
+    "FnVisitor",
+    "PathRecorder",
+    "StateRecorder",
+]

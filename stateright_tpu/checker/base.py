@@ -1,0 +1,229 @@
+"""Checker result surface + builder (reference ``src/checker.rs``).
+
+``CheckerBuilder`` is the fluent entry point (``model.checker()...``); the
+``Checker`` base class is the uniform result surface shared by every strategy
+(CPU BFS, CPU DFS, and the TPU wavefront engine), mirroring reference
+``checker.rs:185-338``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from ..core import Expectation, Model, Property
+from .path import Path
+from .visitor import CheckerVisitor, FnVisitor
+
+# States processed per lock round, as in the reference's job market
+# (reference ``bfs.rs:120``, ``dfs.rs:126``).
+JOB_BLOCK_SIZE = 1500
+
+
+class CheckerBuilder:
+    """Fluent checker configuration (reference ``checker.rs:35-179``)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.symmetry_fn: Optional[Callable] = None
+        self.target_state_count: Optional[int] = None
+        self.thread_count: int = 1
+        self.visitor_obj: Optional[CheckerVisitor] = None
+        self.timeout_secs: Optional[float] = None
+
+    # -- configuration -------------------------------------------------------
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Dedupe on symmetry-class representatives; states must define
+        ``representative()`` (reference ``checker.rs:150-154``)."""
+        self.symmetry_fn = lambda s: s.representative()
+        return self
+
+    def symmetry_with(self, fn: Callable) -> "CheckerBuilder":
+        self.symmetry_fn = fn
+        return self
+
+    def target_states(self, count: int) -> "CheckerBuilder":
+        """Stop after roughly ``count`` unique states
+        (reference ``checker.rs:163-167``)."""
+        self.target_state_count = count
+        return self
+
+    def threads(self, count: int) -> "CheckerBuilder":
+        self.thread_count = max(1, count)
+        return self
+
+    def visitor(self, v) -> "CheckerBuilder":
+        self.visitor_obj = v if isinstance(v, CheckerVisitor) else FnVisitor(v)
+        return self
+
+    def timeout(self, secs: float) -> "CheckerBuilder":
+        self.timeout_secs = secs
+        return self
+
+    # -- strategies ----------------------------------------------------------
+
+    def spawn_bfs(self) -> "Checker":
+        from .bfs import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self) -> "Checker":
+        from .dfs import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_tpu(self, **kw) -> "Checker":
+        """The point of this framework: wavefront BFS on TPU (no reference
+        counterpart; see ``stateright_tpu/parallel/wavefront.py``)."""
+        try:
+            from ..parallel.wavefront import TpuChecker
+        except ImportError as e:  # scaffolding guard until the module lands
+            raise NotImplementedError(
+                "the TPU wavefront engine is not available yet"
+            ) from e
+        return TpuChecker(self, **kw)
+
+    def serve(self, addr: str = "localhost:3000"):
+        """Spawn a BFS check and serve the Explorer web UI over it
+        (reference ``checker.rs:108-114``)."""
+        try:
+            from ..explorer import serve
+        except ImportError as e:
+            raise NotImplementedError("the Explorer is not available yet") from e
+        return serve(self, addr)
+
+
+class Checker:
+    """Uniform result surface for all strategies
+    (reference ``checker.rs:185-338``)."""
+
+    model: Model
+
+    # -- strategy-provided ---------------------------------------------------
+
+    def state_count(self) -> int:
+        """Total states generated, including duplicates."""
+        raise NotImplementedError
+
+    def unique_state_count(self) -> int:
+        raise NotImplementedError
+
+    def max_depth(self) -> int:
+        return 0
+
+    def discoveries(self) -> dict[str, Path]:
+        """Property name -> discovered example/counterexample path."""
+        raise NotImplementedError
+
+    def join(self) -> "Checker":
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    # -- shared --------------------------------------------------------------
+
+    def discovery(self, name: str) -> Optional[Path]:
+        return self.discoveries().get(name)
+
+    def discovery_classification(self, name: str) -> str:
+        """"example" or "counterexample" (reference ``checker.rs:245-252``)."""
+        exp = self.model.property(name).expectation
+        return "example" if exp == Expectation.SOMETIMES else "counterexample"
+
+    def report(self, stream=None) -> "Checker":
+        """Block until done, printing 1 Hz progress then a final ``sec=`` line
+        and discoveries (reference ``checker.rs:217-242``); the ``sec=`` value
+        is the benchmark metric."""
+        stream = stream or sys.stdout
+        start = time.monotonic()
+        last = 0.0
+        while not self.is_done():
+            now = time.monotonic()
+            if now - last >= 1.0:
+                print(
+                    f"Checking. states={self.state_count()}, "
+                    f"unique={self.unique_state_count()}",
+                    file=stream,
+                )
+                last = now
+            time.sleep(0.05)
+        self.join()
+        sec = max(time.monotonic() - start, 1e-9)
+        print(
+            f"Done. states={self.state_count()}, "
+            f"unique={self.unique_state_count()}, sec={sec:.6g}",
+            file=stream,
+        )
+        for name, path in sorted(self.discoveries().items()):
+            cls = self.discovery_classification(name)
+            print(f'Discovered "{name}" {cls} {path!r}', file=stream)
+        return self
+
+    # -- assertions (reference ``checker.rs:256-338``) -----------------------
+
+    def assert_properties(self) -> None:
+        for prop in self.model.properties():
+            if prop.expectation == Expectation.SOMETIMES:
+                self.assert_any_discovery(prop.name)
+            else:
+                self.assert_no_discovery(prop.name)
+
+    def assert_any_discovery(self, name: str) -> Path:
+        path = self.discovery(name)
+        assert path is not None, f"Missing discovery for {name!r}."
+        return path
+
+    def assert_no_discovery(self, name: str) -> None:
+        path = self.discovery(name)
+        assert path is None, (
+            f"Unexpected \"{name}\" {self.discovery_classification(name)} {path!r}"
+        )
+
+    def assert_discovery(self, name: str, actions: Sequence) -> None:
+        """Assert a discovery exists and that ``actions`` is one valid witness
+        trace, by re-executing the model (reference ``checker.rs:293-338``)."""
+        self.assert_any_discovery(name)
+        prop = self.model.property(name)
+        model = self.model
+        last_err = f"no init state admits the action sequence {list(actions)!r}"
+        for init in model.init_states():
+            path = Path.from_actions(model, init, actions)
+            if path is None:
+                continue
+            final = path.final_state()
+            if prop.expectation == Expectation.ALWAYS:
+                assert not prop.condition(model, final), (
+                    f"path does not violate always property {name!r}"
+                )
+                return
+            if prop.expectation == Expectation.SOMETIMES:
+                assert prop.condition(model, final), (
+                    f"path does not satisfy sometimes property {name!r}"
+                )
+                return
+            # EVENTUALLY counterexample: no state along the maximal path
+            # satisfies the condition, and the path ends in a terminal state.
+            assert not any(prop.condition(model, s) for s in path.states()), (
+                f"path satisfies eventually property {name!r}"
+            )
+            assert not model.next_steps(final), (
+                f"path for eventually property {name!r} does not end terminal"
+            )
+            return
+        raise AssertionError(last_err)
+
+
+def init_ebits(properties: Sequence[Property]) -> frozenset[int]:
+    """Initial liveness bits: one per ``eventually`` property, set at path
+    start, cleared when satisfied; bits still set at a terminal state flush as
+    counterexamples (reference ``checker.rs:341-348``).  Like the reference,
+    bits are *not* part of the state fingerprint, which can miss
+    counterexamples on DAG joins and cycles (``bfs.rs:239-257`` FIXMEs) —
+    replicated for parity, pinned by tests."""
+    return frozenset(
+        i for i, p in enumerate(properties) if p.expectation == Expectation.EVENTUALLY
+    )

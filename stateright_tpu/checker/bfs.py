@@ -1,0 +1,139 @@
+"""Parallel breadth-first checker — the CPU oracle (reference ``src/checker/bfs.rs``).
+
+Work is distributed through the shared job market (``pool.py``).  The visited
+map stores ``fp -> parent fp`` so discovery paths are reconstructed by walking
+parent pointers and re-executing the model (reference ``bfs.rs:314-342``).
+
+Semantics pinned by tests (and calibrated against the reference's pinned
+report shapes, ``checker.rs:459-461``):
+
+ - ``state_count`` counts init states plus every generated within-boundary
+   successor, *including duplicates*; ``unique_state_count`` is the visited-map
+   size.
+ - Properties are evaluated when a state is popped; the run stops as soon as
+   every property has a discovery (checked per state, before expansion).
+ - ``eventually`` bookkeeping uses per-path bits flushed at terminal states
+   (see ``base.init_ebits`` for the replicated reference caveats).
+
+Dedup across threads relies on CPython's atomic ``dict.setdefault``: the
+insert either wins (returns our parent fp) or reveals the earlier entry, so a
+state is enqueued exactly once — the Python analogue of the reference's
+DashMap entry API (``bfs.rs:245-259``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..core import Expectation
+from .base import CheckerBuilder, JOB_BLOCK_SIZE, init_ebits
+from .path import Path
+from .pool import WorkerPoolChecker
+
+
+class BfsChecker(WorkerPoolChecker):
+    def __init__(self, options: CheckerBuilder):
+        self.model = options.model
+        self._props = list(self.model.properties())
+        self._prop_count = len(self._props)
+        self._generated: dict[int, int] = {}  # fp -> parent fp (0 for init)
+        self._discoveries: dict[str, int] = {}  # property name -> fp
+
+        ebits = init_ebits(self._props)
+        job = deque()
+        init_count = 0
+        for s in self.model.init_states():
+            if not self.model.within_boundary(s):
+                continue
+            init_count += 1
+            fp = self.model.fingerprint_state(s)
+            if fp not in self._generated:
+                self._generated[fp] = 0
+                job.append((s, fp, ebits))
+        self._start_pool(options, job)
+        self._add_count(init_count)
+
+    # -- strategy hooks ------------------------------------------------------
+
+    def _split_job(self, pending: deque, k: int) -> list:
+        chunk = len(pending) // (k + 1)
+        return [
+            deque(pending.popleft() for _ in range(chunk)) for _ in range(k)
+        ]
+
+    def _check_block(self, pending: deque):
+        model = self.model
+        props = self._props
+        generated = self._generated
+        discoveries = self._discoveries
+        visitor = self._options.visitor_obj
+        target = self._options.target_state_count
+        local_count = 0
+        processed = 0
+        while pending and processed < JOB_BLOCK_SIZE and not self._stop.is_set():
+            state, fp, ebits = pending.popleft()
+            processed += 1
+            if visitor is not None:
+                visitor.visit(model, Path.from_fingerprints(model, self._trace(fp)))
+            # property evaluation (reference ``bfs.rs:192-227``)
+            for i, prop in enumerate(props):
+                if prop.expectation is Expectation.ALWAYS:
+                    if prop.name not in discoveries and not prop.condition(model, state):
+                        discoveries.setdefault(prop.name, fp)
+                elif prop.expectation is Expectation.SOMETIMES:
+                    if prop.name not in discoveries and prop.condition(model, state):
+                        discoveries.setdefault(prop.name, fp)
+                elif i in ebits and prop.condition(model, state):
+                    ebits = ebits - {i}
+            if self._prop_count and len(discoveries) == self._prop_count:
+                self._stop.set()
+                break
+            # expansion (reference ``bfs.rs:229-264``)
+            is_terminal = True
+            for action in model.actions(state):
+                nxt = model.next_state(state, action)
+                if nxt is None:
+                    continue
+                if not model.within_boundary(nxt):
+                    continue
+                local_count += 1
+                is_terminal = False
+                nfp = model.fingerprint_state(nxt)
+                # atomic insert-or-reveal; our write wins iff returned parent
+                # is ours (parents are unique per expanded state, so this
+                # cannot double-enqueue)
+                if generated.setdefault(nfp, fp) == fp and nfp != fp:
+                    pending.append((nxt, nfp, ebits))
+            if is_terminal and ebits:
+                for i in ebits:
+                    discoveries.setdefault(props[i].name, fp)
+                if self._prop_count and len(discoveries) == self._prop_count:
+                    self._stop.set()
+                    break
+            if target is not None and len(generated) >= target:
+                self._stop.set()
+                break
+        self._add_count(local_count)
+
+    # -- path reconstruction -------------------------------------------------
+
+    def _trace(self, fp: int) -> list[int]:
+        fps = [fp]
+        while True:
+            parent = self._generated.get(fps[-1], 0)
+            if parent == 0:
+                break
+            fps.append(parent)
+        fps.reverse()
+        return fps
+
+    # -- Checker surface -----------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self.model, self._trace(fp))
+            for name, fp in dict(self._discoveries).items()
+        }

@@ -1,0 +1,137 @@
+"""Parallel depth-first checker (reference ``src/checker/dfs.rs``).
+
+Far less memory than BFS: the visited set stores bare fingerprints (no parent
+pointers) and each pending entry carries its fingerprint path as a structurally
+shared cons chain (the reference copies a ``Vec<Fingerprint>`` per entry,
+``dfs.rs:25-29``; sharing makes pushes O(1) instead of O(depth)), so discovery
+paths need no reconstruction walk.  Paths found are not generally shortest.
+
+Symmetry reduction applies here only, as in the reference (``dfs.rs:260-285``;
+BFS ignores it): successors are deduplicated on
+``fingerprint(representative(state))`` while the search continues with the
+*original* state so recorded paths stay valid (the reference pins a regression
+test for exactly that subtlety, ``dfs.rs:394-483``).
+
+Cross-thread dedup uses atomic ``dict.setdefault`` with a per-attempt token
+(identity-compared), the Python analogue of DashSet insertion.
+"""
+
+from __future__ import annotations
+
+from ..core import Expectation
+from .base import CheckerBuilder, JOB_BLOCK_SIZE, init_ebits
+from .path import Path
+from .pool import WorkerPoolChecker
+
+
+def _fps(node) -> list[int]:
+    """Materialize a cons fp-path chain ``(fp, parent_node)`` into a list."""
+    out = []
+    while node is not None:
+        out.append(node[0])
+        node = node[1]
+    out.reverse()
+    return out
+
+
+class DfsChecker(WorkerPoolChecker):
+    def __init__(self, options: CheckerBuilder):
+        self.model = options.model
+        self._symmetry = options.symmetry_fn
+        self._props = list(self.model.properties())
+        self._prop_count = len(self._props)
+        self._generated: dict[int, object] = {}  # fp -> insertion token
+        self._discoveries: dict[str, tuple] = {}  # name -> cons fp-path node
+
+        ebits = init_ebits(self._props)
+        job: list = []
+        init_count = 0
+        for s in self.model.init_states():
+            if not self.model.within_boundary(s):
+                continue
+            init_count += 1
+            if self._insert(self._dedup_key(s)):
+                fp = self.model.fingerprint_state(s)
+                job.append((s, (fp, None), ebits))
+        self._start_pool(options, job)
+        self._add_count(init_count)
+
+    def _dedup_key(self, state) -> int:
+        if self._symmetry is not None:
+            return self.model.fingerprint_state(self._symmetry(state))
+        return self.model.fingerprint_state(state)
+
+    def _insert(self, key: int) -> bool:
+        """Atomically insert ``key``; True iff we were first."""
+        token = object()
+        return self._generated.setdefault(key, token) is token
+
+    # -- strategy hooks ------------------------------------------------------
+
+    def _split_job(self, pending: list, k: int) -> list:
+        # share from the bottom of the stack: oldest (shallowest) entries
+        chunk = len(pending) // (k + 1)
+        shares = []
+        for _ in range(k):
+            shares.append(pending[:chunk])
+            del pending[:chunk]
+        return shares
+
+    def _check_block(self, pending: list):
+        model = self.model
+        props = self._props
+        discoveries = self._discoveries
+        visitor = self._options.visitor_obj
+        target = self._options.target_state_count
+        local_count = 0
+        processed = 0
+        while pending and processed < JOB_BLOCK_SIZE and not self._stop.is_set():
+            state, node, ebits = pending.pop()
+            processed += 1
+            if visitor is not None:
+                visitor.visit(model, Path.from_fingerprints(model, _fps(node)))
+            for i, prop in enumerate(props):
+                if prop.expectation is Expectation.ALWAYS:
+                    if prop.name not in discoveries and not prop.condition(model, state):
+                        discoveries.setdefault(prop.name, node)
+                elif prop.expectation is Expectation.SOMETIMES:
+                    if prop.name not in discoveries and prop.condition(model, state):
+                        discoveries.setdefault(prop.name, node)
+                elif i in ebits and prop.condition(model, state):
+                    ebits = ebits - {i}
+            if self._prop_count and len(discoveries) == self._prop_count:
+                self._stop.set()
+                break
+            is_terminal = True
+            for action in model.actions(state):
+                nxt = model.next_state(state, action)
+                if nxt is None:
+                    continue
+                if not model.within_boundary(nxt):
+                    continue
+                local_count += 1
+                is_terminal = False
+                if self._insert(self._dedup_key(nxt)):
+                    nfp = model.fingerprint_state(nxt)
+                    pending.append((nxt, (nfp, node), ebits))
+            if is_terminal and ebits:
+                for i in ebits:
+                    discoveries.setdefault(props[i].name, node)
+                if self._prop_count and len(discoveries) == self._prop_count:
+                    self._stop.set()
+                    break
+            if target is not None and len(self._generated) >= target:
+                self._stop.set()
+                break
+        self._add_count(local_count)
+
+    # -- Checker surface -----------------------------------------------------
+
+    def unique_state_count(self) -> int:
+        return len(self._generated)
+
+    def discoveries(self) -> dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self.model, _fps(node))
+            for name, node in dict(self._discoveries).items()
+        }

@@ -1,0 +1,125 @@
+"""Traces through a model's state space (reference ``src/checker/path.rs``).
+
+A :class:`Path` is a sequence ``state --action--> state --action--> ... state``.
+Checkers store only fingerprints (device-side the TPU engine stores only
+``fp -> parent fp``), so materializing a path *re-executes* the model and
+matches successor fingerprints (reference ``path.rs:20-86``).  If re-execution
+cannot reproduce a recorded fingerprint the model is nondeterministic (e.g.
+iteration over an unordered container with randomized order, wall-clock reads,
+RNG without fixed seed) and we raise with a detailed diagnostic, as the
+reference does (``path.rs:35-49``).
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Iterable, Optional, Sequence, TypeVar
+
+State = TypeVar("State")
+Action = TypeVar("Action")
+
+_NONDETERMINISM_MSG = """\
+Failed to reconstruct a path because the model is not deterministic.
+Refusing to continue. This usually happens when a state contains a
+container whose iteration order is not stable across identical states
+(e.g. iterating a Python set whose insertion order differs), or when
+actions/next_state consult randomness or wall-clock time. Make the
+model a pure function of its inputs. Missing fingerprint: {fp:#018x}
+after {n} matched step(s)."""
+
+
+class Path(Generic[State, Action]):
+    """A pair sequence ``[(state, action), ..., (final_state, None)]``."""
+
+    def __init__(self, pairs: Sequence[tuple[State, Optional[Action]]]):
+        if not pairs:
+            raise ValueError("empty path")
+        self._pairs = list(pairs)
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def from_fingerprints(model, fingerprints: Sequence[int]) -> "Path":
+        """Re-execute ``model`` along a fingerprint trace
+        (reference ``path.rs:20-86``)."""
+        if not fingerprints:
+            raise ValueError("empty fingerprint path")
+        fps = list(fingerprints)
+        init_fp = fps[0]
+        state = None
+        for s in model.init_states():
+            if model.fingerprint_state(s) == init_fp:
+                state = s
+                break
+        if state is None:
+            raise RuntimeError(_NONDETERMINISM_MSG.format(fp=init_fp, n=0))
+        pairs: list[tuple[State, Optional[Action]]] = []
+        for i, want in enumerate(fps[1:], start=1):
+            found = None
+            for action in model.actions(state):
+                nxt = model.next_state(state, action)
+                if nxt is not None and model.fingerprint_state(nxt) == want:
+                    found = (action, nxt)
+                    break
+            if found is None:
+                raise RuntimeError(_NONDETERMINISM_MSG.format(fp=want, n=i - 1))
+            pairs.append((state, found[0]))
+            state = found[1]
+        pairs.append((state, None))
+        return Path(pairs)
+
+    @staticmethod
+    def from_actions(
+        model, init_state: State, actions: Iterable[Action]
+    ) -> Optional["Path"]:
+        """Follow an action sequence from ``init_state``; ``None`` if any
+        action is unavailable (reference ``path.rs:90-112``)."""
+        pairs: list[tuple[State, Optional[Action]]] = []
+        state = init_state
+        for action in actions:
+            available = list(model.actions(state))
+            if action not in available:
+                return None
+            nxt = model.next_state(state, action)
+            if nxt is None:
+                return None
+            pairs.append((state, action))
+            state = nxt
+        pairs.append((state, None))
+        return Path(pairs)
+
+    # -- accessors -----------------------------------------------------------
+
+    def last_state(self) -> State:
+        return self._pairs[-1][0]
+
+    final_state = last_state
+
+    def states(self) -> list[State]:
+        return [s for s, _ in self._pairs]
+
+    def actions(self) -> list[Action]:
+        return [a for _, a in self._pairs if a is not None]
+
+    def into_vec(self) -> list[tuple[State, Optional[Action]]]:
+        return list(self._pairs)
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Path) and self._pairs == other._pairs
+
+    def __hash__(self) -> int:
+        # hash by action/state reprs to allow storing in sets
+        return hash(tuple((repr(s), repr(a)) for s, a in self._pairs))
+
+    def encode(self, model) -> str:
+        """``/``-joined fingerprints, as used in Explorer URLs
+        (reference ``path.rs:160-165``)."""
+        return "/".join(str(model.fingerprint_state(s)) for s, _ in self._pairs)
+
+    def __repr__(self) -> str:
+        return "Path[" + ", ".join(repr(a) for a in self.actions()) + "]"
+
+    def __str__(self) -> str:
+        return "\n".join(str(a) for a in self.actions())

@@ -1,0 +1,53 @@
+"""Per-evaluated-state callbacks (reference ``src/checker/visitor.rs``).
+
+A visitor observes every state the checker evaluates, receiving the full
+:class:`~stateright_tpu.checker.path.Path` that led there.  The Explorer's
+live snapshot and the visit-order tests are both built on this hook.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from .path import Path
+
+
+class CheckerVisitor:
+    def visit(self, model, path: Path) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FnVisitor(CheckerVisitor):
+    """Wrap a plain callable (reference ``visitor.rs:23-30``)."""
+
+    def __init__(self, fn: Callable[[object, Path], None]):
+        self._fn = fn
+
+    def visit(self, model, path: Path) -> None:
+        self._fn(model, path)
+
+
+class PathRecorder(CheckerVisitor):
+    """Records the set of visited paths (reference ``visitor.rs:46-67``)."""
+
+    def __init__(self):
+        self.paths: set[Path] = set()
+        self._lock = threading.Lock()
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            self.paths.add(path)
+
+
+class StateRecorder(CheckerVisitor):
+    """Records final states of visited paths in visit order
+    (reference ``visitor.rs:81-100``)."""
+
+    def __init__(self):
+        self.states: list = []
+        self._lock = threading.Lock()
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            self.states.append(path.final_state())

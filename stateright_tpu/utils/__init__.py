@@ -1,0 +1,6 @@
+"""L0 utilities (reference ``src/util.rs``, ``src/util/``)."""
+
+from .densenatmap import DenseNatMap
+from .vector_clock import VectorClock
+
+__all__ = ["DenseNatMap", "VectorClock"]

@@ -1,0 +1,19 @@
+"""Test harness config.
+
+Tests never assume real TPU hardware: JAX is forced onto CPU with 8 virtual
+devices so multi-chip sharding (mesh + all-to-all fingerprint routing) is
+exercised exactly as the driver's ``dryrun_multichip`` does.  Must run before
+jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,102 @@
+"""Fake models for checker tests (reference ``src/test_util.rs``).
+
+These define correctness for the checkers: exact visit orders, exact state
+counts, and liveness semantics are pinned against them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from stateright_tpu import Expectation, Model, Property
+
+
+class BinaryClock(Model):
+    """2-state toggle model (reference ``test_util.rs:4-46``).
+    States are 0/1; init both; action flips."""
+
+    def init_states(self):
+        return [0, 1]
+
+    def actions(self, state):
+        return ["toggle"]
+
+    def next_state(self, state, action):
+        return 1 - state
+
+    def properties(self):
+        return [Property.always("in bounds", lambda m, s: s in (0, 1))]
+
+
+@dataclass
+class DGraph(Model):
+    """Directed graph with explicit edges + configurable properties — the
+    harness for eventually/liveness semantics tests
+    (reference ``test_util.rs:49-117``)."""
+
+    inits: Sequence[int]
+    edges: dict[int, Sequence[int]]
+    props: Sequence[Property] = field(default_factory=list)
+
+    def init_states(self):
+        return list(self.inits)
+
+    def actions(self, state):
+        return list(self.edges.get(state, []))
+
+    def next_state(self, state, action):
+        return action  # action IS the destination node
+
+    def properties(self):
+        return list(self.props)
+
+
+@dataclass
+class FnModel(Model):
+    """Model from a successor function, for path-reconstruction failure tests
+    (reference ``test_util.rs:120-138``)."""
+
+    inits: Sequence
+    successors: Callable[[object], Sequence]
+
+    def init_states(self):
+        return list(self.inits)
+
+    def actions(self, state):
+        return list(range(len(self.successors(state))))
+
+    def next_state(self, state, action):
+        succ = self.successors(state)
+        return succ[action] if action < len(succ) else None
+
+
+@dataclass
+class LinearEquation(Model):
+    """Solve ``a*x + b*y = c (mod 256)`` by nondeterministic increments — the
+    canonical checker test with known BFS/DFS visit orders and state counts
+    (reference ``test_util.rs:141-188``).  State is ``(x, y)`` with u8 wrap."""
+
+    a: int
+    b: int
+    c: int
+
+    def init_states(self):
+        return [(0, 0)]
+
+    def actions(self, state):
+        return ["IncreaseX", "IncreaseY"]
+
+    def next_state(self, state, action):
+        x, y = state
+        if action == "IncreaseX":
+            return ((x + 1) % 256, y)
+        return (x, (y + 1) % 256)
+
+    def properties(self):
+        return [
+            Property.sometimes(
+                "solvable",
+                lambda m, s: (m.a * s[0] + m.b * s[1]) % 256 == m.c % 256,
+            )
+        ]

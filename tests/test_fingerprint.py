@@ -1,0 +1,83 @@
+"""Stable-hash behavior: cross-run stability, order-insensitivity for
+sets/maps, type distinction (reference stability contract ``lib.rs:330-344``)."""
+
+from dataclasses import dataclass
+
+from stateright_tpu.fingerprint import (
+    FINGERPRINT_SEED,
+    hash_words,
+    mix64,
+    stable_hash,
+)
+
+
+def test_mix64_known_values():
+    # pinned so any accidental change to the mixer (which would invalidate
+    # every stored fingerprint) fails loudly
+    assert mix64(0) == 0
+    assert mix64(1) == 0x5692161D100B05E5 == stable_mix_1()
+
+
+def stable_mix_1():
+    h = 1
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) % (1 << 64)
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) % (1 << 64)
+    h ^= h >> 31
+    return h
+
+
+def test_hash_words_nonzero_and_length_sensitive():
+    assert hash_words([]) != 0
+    assert hash_words([0]) != hash_words([0, 0])
+    assert hash_words([1, 2]) != hash_words([2, 1])
+
+
+def test_scalars_distinct():
+    vals = [None, True, False, 0, 1, -1, 0.0, 1.0, "", "a", b"a", (), (0,), [0]]
+    hashes = [stable_hash(v) for v in vals]
+    assert len(set(hashes)) == len(hashes)
+
+
+def test_int_vs_str_vs_float_distinct():
+    assert stable_hash(1) != stable_hash("1")
+    assert stable_hash(1) != stable_hash(1.0)
+    assert stable_hash((1, 2)) != stable_hash([1, 2])
+
+
+def test_set_order_insensitive():
+    assert stable_hash({1, 2, 3}) == stable_hash({3, 1, 2})
+    assert stable_hash(frozenset(["a", "b"])) == stable_hash({"b", "a"})
+    assert stable_hash({1: "x", 2: "y"}) == stable_hash({2: "y", 1: "x"})
+
+
+def test_dict_key_value_pairing():
+    assert stable_hash({1: 2, 3: 4}) != stable_hash({1: 4, 3: 2})
+
+
+def test_dataclass_hash():
+    @dataclass
+    class P:
+        x: int
+        y: int
+
+    assert stable_hash(P(1, 2)) == stable_hash(P(1, 2))
+    assert stable_hash(P(1, 2)) != stable_hash(P(2, 1))
+
+
+def test_bigint():
+    big = 1 << 200
+    assert stable_hash(big) == stable_hash(1 << 200)
+    assert stable_hash(big) != stable_hash(-big)
+
+
+def test_cross_process_stability():
+    # values pinned once; if these move, Explorer URLs and stored traces break
+    assert FINGERPRINT_SEED == 0x5374617465544655
+    assert stable_hash((0, 0)) == stable_hash((0, 0))
+
+
+def test_negative_int_does_not_collide_with_wrapped_unsigned():
+    assert stable_hash(-1) != stable_hash((1 << 64) - 1)
+    assert stable_hash(-5) != stable_hash(5)
