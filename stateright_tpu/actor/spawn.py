@@ -1,0 +1,146 @@
+"""Real UDP actor runtime (reference ``src/actor/spawn.rs``).
+
+The same actor code that was model checked can be deployed: one OS thread per
+actor, a blocking UDP socket loop, timers implemented as receive timeouts
+(reference ``spawn.rs:63-140``).  Ids encode IPv4 socket addresses
+(``spawn.rs:9-33`` — see :meth:`Id.from_addr`/:meth:`Id.to_addr`).
+
+Serialization is pluggable per actor via ``Actor.serialize``/``deserialize``
+(JSON by default, as in the reference's examples).  Malformed or non-IPv4
+input is logged and ignored (``spawn.rs:105-133``).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import threading
+import time
+from typing import Iterable, Optional, Tuple
+
+from . import Actor, CancelTimer, Id, Out, Send, SetTimer
+
+log = logging.getLogger(__name__)
+
+#: Used when no timer is set (reference ``practically_never``, ``spawn.rs:36-38``).
+_PRACTICALLY_NEVER = 60.0 * 60.0 * 24.0 * 365.0
+
+
+class SpawnedActor:
+    """Handle to a running actor thread."""
+
+    def __init__(self, id: Id, actor: Actor):
+        self.id = id
+        self.actor = actor
+        self.thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.state = None  # exposed for tests/debugging
+
+    def stop(self):
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None):
+        if self.thread:
+            self.thread.join(timeout)
+
+
+def _run(handle: SpawnedActor) -> None:
+    actor, id = handle.actor, handle.id
+    ip, port = id.to_addr()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind((ip, port))
+    try:
+        out = Out()
+        state = actor.on_start(id, out)
+        log.info("%r started: %r", id, state)
+        timer_deadline: Optional[float] = None
+        timer_deadline = _on_commands(actor, id, sock, out, timer_deadline)
+        while not handle._stop.is_set():
+            handle.state = state
+            timeout = (
+                max(0.0, timer_deadline - time.monotonic())
+                if timer_deadline is not None
+                else _PRACTICALLY_NEVER
+            )
+            sock.settimeout(min(timeout, 0.2))  # 0.2s tick to observe stop()
+            out = Out()
+            try:
+                data, addr = sock.recvfrom(65536)
+            except socket.timeout:
+                if (
+                    timer_deadline is not None
+                    and time.monotonic() >= timer_deadline
+                ):
+                    timer_deadline = None
+                    new = actor.on_timeout(id, state, out)
+                    if new is not None:
+                        state = new
+                    timer_deadline = _on_commands(
+                        actor, id, sock, out, timer_deadline
+                    )
+                continue
+            try:
+                msg = actor.deserialize(data)
+            except Exception as e:  # malformed input is logged and ignored
+                log.warning("%r failed to deserialize %r: %r", id, data[:64], e)
+                continue
+            src = Id.from_addr(addr[0], addr[1])
+            new = actor.on_msg(id, state, src, msg, out)
+            if new is not None:
+                state = new
+            timer_deadline = _on_commands(actor, id, sock, out, timer_deadline)
+    finally:
+        sock.close()
+
+
+def _on_commands(
+    actor: Actor,
+    id: Id,
+    sock: socket.socket,
+    out: Out,
+    timer_deadline: Optional[float],
+) -> Optional[float]:
+    """Apply emitted commands: sends serialize + send_to; SetTimer samples the
+    random range (reference ``spawn.rs:143-183``)."""
+    for c in out.commands:
+        if isinstance(c, Send):
+            try:
+                data = actor.serialize(c.msg)
+            except Exception as e:
+                log.warning("%r failed to serialize %r: %r", id, c.msg, e)
+                continue
+            ip, port = Id(c.dst).to_addr()
+            log.info("%r sending %r to %r", id, c.msg, c.dst)
+            sock.sendto(data, (ip, port))
+        elif isinstance(c, SetTimer):
+            low, high = c.duration
+            timer_deadline = time.monotonic() + random.uniform(low, max(low, high))
+        elif isinstance(c, CancelTimer):
+            timer_deadline = None
+    return timer_deadline
+
+
+def spawn(
+    actors: Iterable[Tuple[Id, Actor]], background: bool = True
+) -> list[SpawnedActor]:
+    """Run actors on real UDP sockets, one thread each
+    (reference ``spawn.rs:63-140``).
+
+    ``actors`` pairs each actor with the :class:`Id` encoding its socket
+    address (e.g. ``Id.from_addr("127.0.0.1", 3000)``).  Returns handles;
+    with ``background=False`` blocks until all threads exit.
+    """
+    handles = []
+    for id, actor in actors:
+        handle = SpawnedActor(Id(id), actor)
+        handle.thread = threading.Thread(
+            target=_run, args=(handle,), daemon=True
+        )
+        handles.append(handle)
+    for h in handles:
+        h.thread.start()
+    if not background:
+        for h in handles:
+            h.join()
+    return handles

@@ -131,7 +131,7 @@ class Checker:
 
     def discovery_classification(self, name: str) -> str:
         """"example" or "counterexample" (reference ``checker.rs:245-252``)."""
-        exp = self.model.property(name).expectation
+        exp = self.model.property_by_name(name).expectation
         return "example" if exp == Expectation.SOMETIMES else "counterexample"
 
     def report(self, stream=None) -> "Checker":
@@ -187,7 +187,7 @@ class Checker:
         """Assert a discovery exists and that ``actions`` is one valid witness
         trace, by re-executing the model (reference ``checker.rs:293-338``)."""
         self.assert_any_discovery(name)
-        prop = self.model.property(name)
+        prop = self.model.property_by_name(name)
         model = self.model
         last_err = f"no init state admits the action sequence {list(actions)!r}"
         for init in model.init_states():
@@ -215,6 +215,34 @@ class Checker:
             )
             return
         raise AssertionError(last_err)
+
+
+def evaluate_properties(
+    model, props: Sequence[Property], discoveries: dict, state, ebits, token
+):
+    """Shared per-state property evaluation (reference ``bfs.rs:192-227``):
+    record always-counterexamples / sometimes-examples under ``token``
+    (first writer wins), clear satisfied eventually-bits.  Returns updated
+    ebits."""
+    for i, prop in enumerate(props):
+        if prop.expectation is Expectation.ALWAYS:
+            if prop.name not in discoveries and not prop.condition(model, state):
+                discoveries.setdefault(prop.name, token)
+        elif prop.expectation is Expectation.SOMETIMES:
+            if prop.name not in discoveries and prop.condition(model, state):
+                discoveries.setdefault(prop.name, token)
+        elif i in ebits and prop.condition(model, state):
+            ebits = ebits - {i}
+    return ebits
+
+
+def flush_terminal_ebits(
+    props: Sequence[Property], discoveries: dict, ebits, token
+) -> None:
+    """Liveness bits still set at a terminal state are counterexamples
+    (reference ``bfs.rs:265-272``)."""
+    for i in ebits:
+        discoveries.setdefault(props[i].name, token)
 
 
 def init_ebits(properties: Sequence[Property]) -> frozenset[int]:

@@ -25,8 +25,13 @@ from __future__ import annotations
 
 from collections import deque
 
-from ..core import Expectation
-from .base import CheckerBuilder, JOB_BLOCK_SIZE, init_ebits
+from .base import (
+    CheckerBuilder,
+    JOB_BLOCK_SIZE,
+    evaluate_properties,
+    flush_terminal_ebits,
+    init_ebits,
+)
 from .path import Path
 from .pool import WorkerPoolChecker
 
@@ -75,21 +80,15 @@ class BfsChecker(WorkerPoolChecker):
             processed += 1
             if visitor is not None:
                 visitor.visit(model, Path.from_fingerprints(model, self._trace(fp)))
-            # property evaluation (reference ``bfs.rs:192-227``)
-            for i, prop in enumerate(props):
-                if prop.expectation is Expectation.ALWAYS:
-                    if prop.name not in discoveries and not prop.condition(model, state):
-                        discoveries.setdefault(prop.name, fp)
-                elif prop.expectation is Expectation.SOMETIMES:
-                    if prop.name not in discoveries and prop.condition(model, state):
-                        discoveries.setdefault(prop.name, fp)
-                elif i in ebits and prop.condition(model, state):
-                    ebits = ebits - {i}
+            ebits = evaluate_properties(
+                model, props, discoveries, state, ebits, fp
+            )
             if self._prop_count and len(discoveries) == self._prop_count:
                 self._stop.set()
                 break
             # expansion (reference ``bfs.rs:229-264``)
             is_terminal = True
+            seen_children = set()  # two actions can yield the same successor
             for action in model.actions(state):
                 nxt = model.next_state(state, action)
                 if nxt is None:
@@ -99,14 +98,16 @@ class BfsChecker(WorkerPoolChecker):
                 local_count += 1
                 is_terminal = False
                 nfp = model.fingerprint_state(nxt)
-                # atomic insert-or-reveal; our write wins iff returned parent
-                # is ours (parents are unique per expanded state, so this
-                # cannot double-enqueue)
-                if generated.setdefault(nfp, fp) == fp and nfp != fp:
+                if nfp in seen_children or nfp == fp:
+                    continue
+                # atomic insert-or-reveal: cross-thread races resolve by
+                # parent fp; same-parent duplicates are caught above, so a
+                # returned parent equal to ours means our insert won
+                if generated.setdefault(nfp, fp) == fp:
+                    seen_children.add(nfp)
                     pending.append((nxt, nfp, ebits))
             if is_terminal and ebits:
-                for i in ebits:
-                    discoveries.setdefault(props[i].name, fp)
+                flush_terminal_ebits(props, discoveries, ebits, fp)
                 if self._prop_count and len(discoveries) == self._prop_count:
                     self._stop.set()
                     break

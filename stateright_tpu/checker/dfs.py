@@ -18,8 +18,13 @@ Cross-thread dedup uses atomic ``dict.setdefault`` with a per-attempt token
 
 from __future__ import annotations
 
-from ..core import Expectation
-from .base import CheckerBuilder, JOB_BLOCK_SIZE, init_ebits
+from .base import (
+    CheckerBuilder,
+    JOB_BLOCK_SIZE,
+    evaluate_properties,
+    flush_terminal_ebits,
+    init_ebits,
+)
 from .path import Path
 from .pool import WorkerPoolChecker
 
@@ -90,15 +95,9 @@ class DfsChecker(WorkerPoolChecker):
             processed += 1
             if visitor is not None:
                 visitor.visit(model, Path.from_fingerprints(model, _fps(node)))
-            for i, prop in enumerate(props):
-                if prop.expectation is Expectation.ALWAYS:
-                    if prop.name not in discoveries and not prop.condition(model, state):
-                        discoveries.setdefault(prop.name, node)
-                elif prop.expectation is Expectation.SOMETIMES:
-                    if prop.name not in discoveries and prop.condition(model, state):
-                        discoveries.setdefault(prop.name, node)
-                elif i in ebits and prop.condition(model, state):
-                    ebits = ebits - {i}
+            ebits = evaluate_properties(
+                model, props, discoveries, state, ebits, node
+            )
             if self._prop_count and len(discoveries) == self._prop_count:
                 self._stop.set()
                 break
@@ -115,8 +114,7 @@ class DfsChecker(WorkerPoolChecker):
                     nfp = model.fingerprint_state(nxt)
                     pending.append((nxt, (nfp, node), ebits))
             if is_terminal and ebits:
-                for i in ebits:
-                    discoveries.setdefault(props[i].name, node)
+                flush_terminal_ebits(props, discoveries, ebits, node)
                 if self._prop_count and len(discoveries) == self._prop_count:
                     self._stop.set()
                     break

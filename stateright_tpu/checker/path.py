@@ -110,8 +110,19 @@ class Path(Generic[State, Action]):
         return isinstance(other, Path) and self._pairs == other._pairs
 
     def __hash__(self) -> int:
-        # hash by action/state reprs to allow storing in sets
-        return hash(tuple((repr(s), repr(a)) for s, a in self._pairs))
+        from ..fingerprint import stable_hash
+
+        try:
+            return stable_hash(
+                tuple(
+                    (stable_hash(s), 0 if a is None else stable_hash(a))
+                    for s, a in self._pairs
+                )
+            )
+        except TypeError:
+            # exotic unhashable actions: degrade to a weak but
+            # eq-consistent hash
+            return len(self._pairs)
 
     def encode(self, model) -> str:
         """``/``-joined fingerprints, as used in Explorer URLs

@@ -111,7 +111,7 @@ class Model(Generic[State, Action]):
     def properties(self) -> Sequence[Property]:
         return []
 
-    def property(self, name: str) -> Property:
+    def property_by_name(self, name: str) -> Property:
         for p in self.properties():
             if p.name == name:
                 return p

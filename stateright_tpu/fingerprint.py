@@ -176,6 +176,10 @@ def stable_words(obj: Any, out: list[int]) -> None:
             stable_words(type(obj).__name__, out)
             for f in dataclasses.fields(obj):
                 stable_words(getattr(obj, f.name), out)
+        elif isinstance(obj, int):
+            # int subclasses without custom hooks (e.g. actor Id) hash as
+            # their integer value
+            stable_words(int(obj), out)
         else:
             raise TypeError(
                 f"cannot stably hash {type(obj).__name__}: define stable_words(out),"

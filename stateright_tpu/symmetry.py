@@ -1,0 +1,112 @@
+"""Symmetry reduction (reference L2b: ``src/checker/representative.rs``,
+``rewrite.rs``, ``rewrite_plan.rs``).
+
+Many distributed systems are symmetric under permutations of identical
+processes: exploring one member of each equivalence class suffices.  A state
+type opts in by defining ``representative()`` returning the canonical member
+of its class; the DFS checker then dedups on
+``fingerprint(representative(state))`` while continuing the search with the
+original state so paths remain valid (reference ``dfs.rs:260-285``).
+
+:class:`RewritePlan` captures a permutation derived by sorting values (the
+reference's double argsort, ``rewrite_plan.rs:74-96`` — argsort is also
+TPU-friendly, which the tensor form exploits for vectorized representative
+hashing).  :func:`rewrite_value` recursively applies a plan through tuples,
+sets, dicts, dataclasses, and anything defining ``rewrite(plan)``
+(reference ``rewrite.rs:49-135``).
+
+Unlike the reference, ``reindex`` here is a pure permutation — element
+rewriting is explicit via :func:`rewrite_value` — which keeps the two
+operations composable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from .fingerprint import stable_hash
+
+
+class RewritePlan:
+    """A permutation of dense nat-like ids: ``mapping[old] = new``."""
+
+    def __init__(self, mapping: Sequence[int]):
+        self.mapping = list(mapping)
+
+    @staticmethod
+    def from_values_to_sort(
+        values: Iterable[Any], key: Optional[Callable] = None
+    ) -> "RewritePlan":
+        """Plan that would stably sort ``values``: double argsort
+        (reference ``rewrite_plan.rs:74-96``).  ``key`` defaults to the
+        values themselves; pass ``stable_hash`` for unorderable values."""
+        vals = list(values)
+        keyed = [(key(v) if key else v) for v in vals]
+        order = sorted(range(len(vals)), key=lambda i: keyed[i])  # new -> old
+        mapping = [0] * len(vals)
+        for new, old in enumerate(order):
+            mapping[old] = new
+        return RewritePlan(mapping)
+
+    def rewrite_id(self, x: int) -> int:
+        from .actor import Id
+
+        return Id(self.mapping[int(x)])
+
+    def reindex(self, seq: Sequence) -> list:
+        """Permute a dense vector: ``result[new] = seq[old]``."""
+        out = [None] * len(self.mapping)
+        for old, new in enumerate(self.mapping):
+            out[new] = seq[old]
+        return out
+
+    def __repr__(self):
+        return f"RewritePlan({self.mapping!r})"
+
+
+def rewrite_value(x: Any, plan: RewritePlan) -> Any:
+    """Recursively rewrite actor Ids inside ``x`` per ``plan``
+    (reference ``rewrite.rs:18-135``)."""
+    from .actor import Id
+
+    if isinstance(x, Id):
+        return plan.rewrite_id(x)
+    if x is None or isinstance(x, (bool, str, bytes, float, Enum)):
+        return x
+    if type(x) is int:
+        return x
+    rw = getattr(x, "rewrite", None)
+    if rw is not None:
+        return rw(plan)
+    if isinstance(x, tuple):
+        return tuple(rewrite_value(v, plan) for v in x)
+    if isinstance(x, list):
+        return [rewrite_value(v, plan) for v in x]
+    if isinstance(x, frozenset):
+        return frozenset(rewrite_value(v, plan) for v in x)
+    if isinstance(x, set):
+        return {rewrite_value(v, plan) for v in x}
+    if isinstance(x, dict):
+        return {
+            rewrite_value(k, plan): rewrite_value(v, plan) for k, v in x.items()
+        }
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return dataclasses.replace(
+            x,
+            **{
+                f.name: rewrite_value(getattr(x, f.name), plan)
+                for f in dataclasses.fields(x)
+            },
+        )
+    if isinstance(x, int):  # int subclasses other than Id
+        return x
+    return x  # opaque scalars pass through unchanged
+
+
+def sorted_representative(values: Sequence[Any]) -> tuple[list, RewritePlan]:
+    """Sort ``values`` into canonical order (by stable hash, which tolerates
+    unorderable heterogeneous states) and return (sorted, plan)."""
+    plan = RewritePlan.from_values_to_sort(values, key=stable_hash)
+    return plan.reindex(values), plan

@@ -1,0 +1,94 @@
+"""Ping-pong actor fixture (reference ``src/actor/actor_test_util.rs``).
+
+Two actors bounce a counter; history optionally tracks (#in, #out) message
+counts; six properties span all three expectations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import Actor, ActorModel, Id, Out
+
+
+@dataclass
+class PingPongActor(Actor):
+    serve_to: Optional[Id] = None
+
+    def on_start(self, id, out):
+        if self.serve_to is not None:
+            out.send(self.serve_to, ("Ping", 0))
+        return 0
+
+    def on_msg(self, id, state, src, msg, out):
+        kind, value = msg
+        if kind == "Pong" and state == value:
+            out.send(src, ("Ping", value + 1))
+            return state + 1
+        if kind == "Ping" and state == value:
+            out.send(src, ("Pong", value))
+            return state + 1
+        return None
+
+
+@dataclass
+class PingPongCfg:
+    maintains_history: bool = False
+    max_nat: int = 5
+
+
+def ping_pong_model(cfg: PingPongCfg) -> ActorModel:
+    def record_in(c, history, env):
+        if c.maintains_history:
+            i, o = history
+            return (i + 1, o)
+        return None
+
+    def record_out(c, history, env):
+        if c.maintains_history:
+            i, o = history
+            return (i, o + 1)
+        return None
+
+    return (
+        ActorModel(cfg, (0, 0))
+        .actor(PingPongActor(serve_to=Id(1)))
+        .actor(PingPongActor())
+        .record_msg_in(record_in)
+        .record_msg_out(record_out)
+        .within_boundary_(
+            lambda c, state: all(s <= c.max_nat for s in state.actor_states)
+        )
+        .property(
+            Expectation.ALWAYS,
+            "delta within 1",
+            lambda m, s: max(s.actor_states) - min(s.actor_states) <= 1,
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "can reach max",
+            lambda m, s: any(c == m.cfg.max_nat for c in s.actor_states),
+        )
+        .property(
+            Expectation.EVENTUALLY,
+            "must reach max",
+            lambda m, s: any(c == m.cfg.max_nat for c in s.actor_states),
+        )
+        .property(
+            Expectation.EVENTUALLY,
+            "must exceed max",  # falsifiable due to the boundary
+            lambda m, s: any(c == m.cfg.max_nat + 1 for c in s.actor_states),
+        )
+        .property(
+            Expectation.ALWAYS,
+            "#in <= #out",
+            lambda m, s: s.history[0] <= s.history[1],
+        )
+        .property(
+            Expectation.EVENTUALLY,
+            "#out <= #in + 1",
+            lambda m, s: s.history[1] <= s.history[0] + 1,
+        )
+    )

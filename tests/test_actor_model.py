@@ -1,0 +1,318 @@
+"""Actor model tests (reference ``src/actor/model.rs`` tests).
+
+Pins the exhaustive 14-state space of ping-pong at max_nat=1 on a lossy
+duplicating network (reference ``model.rs:506-600``), the 4,094 / 11 counts
+at max_nat=5 (``model.rs:611,642``), network-semantics behavioural
+differences, timer semantics, and heterogeneous actor composition
+(the reference needs a ``Choice`` combinator, ``model.rs:862-977``).
+"""
+
+import pytest
+
+from stateright_tpu import Expectation, StateRecorder
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    ActorModelState,
+    Deliver,
+    Drop,
+    Envelope,
+    Id,
+    Network,
+    ScriptedActor,
+    Timeout,
+    majority,
+    model_peers,
+)
+
+from fixtures_actor import PingPongCfg, ping_pong_model
+
+
+def _states_and_network(states, envelopes, history=(0, 0)):
+    return ActorModelState(
+        actor_states=tuple(states),
+        network=Network.new_unordered_duplicating(envelopes),
+        is_timer_set=(False,) * len(states),
+        history=history,
+    )
+
+
+def _env(src, dst, msg):
+    return Envelope(src=Id(src), dst=Id(dst), msg=msg)
+
+
+def test_visits_expected_states_exhaustively():
+    """Exact full-state-space equality (reference ``model.rs:506-600``)."""
+    recorder = StateRecorder()
+    model = ping_pong_model(PingPongCfg(maintains_history=False, max_nat=1))
+    model.lossy = True
+    checker = model.checker().visitor(recorder).spawn_bfs().join()
+    assert checker.unique_state_count() == 14
+    Ping, Pong = lambda v: ("Ping", v), lambda v: ("Pong", v)
+    expected = {
+        # lossless evolution
+        _states_and_network([0, 0], [_env(0, 1, Ping(0))]),
+        _states_and_network([0, 1], [_env(0, 1, Ping(0)), _env(1, 0, Pong(0))]),
+        _states_and_network(
+            [1, 1],
+            [_env(0, 1, Ping(0)), _env(1, 0, Pong(0)), _env(0, 1, Ping(1))],
+        ),
+        # after losing the only message at (0, 0)
+        _states_and_network([0, 0], []),
+        # losses from (0, 1)
+        _states_and_network([0, 1], [_env(1, 0, Pong(0))]),
+        _states_and_network([0, 1], [_env(0, 1, Ping(0))]),
+        _states_and_network([0, 1], []),
+        # losses from (1, 1)
+        _states_and_network([1, 1], [_env(1, 0, Pong(0)), _env(0, 1, Ping(1))]),
+        _states_and_network([1, 1], [_env(0, 1, Ping(0)), _env(0, 1, Ping(1))]),
+        _states_and_network([1, 1], [_env(0, 1, Ping(0)), _env(1, 0, Pong(0))]),
+        _states_and_network([1, 1], [_env(0, 1, Ping(1))]),
+        _states_and_network([1, 1], [_env(1, 0, Pong(0))]),
+        _states_and_network([1, 1], [_env(0, 1, Ping(0))]),
+        _states_and_network([1, 1], []),
+    }
+    assert set(recorder.states) == expected
+
+
+def test_maintains_fixed_delta_despite_lossy_duplicating_network():
+    model = ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+    model.lossy = True
+    checker = model.checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 4094
+    checker.assert_no_discovery("delta within 1")
+
+
+def test_may_never_reach_max_on_lossy_network():
+    model = ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+    model.lossy = True
+    checker = model.checker().spawn_bfs().join()
+    # can lose the first message and get stuck
+    checker.assert_discovery(
+        "must reach max", [Drop(_env(0, 1, ("Ping", 0)))]
+    )
+
+
+def test_eventually_reaches_max_on_perfect_delivery_network():
+    model = ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+    model.init_network = Network.new_unordered_nonduplicating()
+    checker = model.checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_can_reach_max():
+    model = ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+    checker = model.checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 11
+    path = checker.assert_any_discovery("can reach max")
+    assert path.final_state().actor_states == (4, 5)
+
+
+def test_history_properties():
+    model = ping_pong_model(PingPongCfg(maintains_history=True, max_nat=3))
+    checker = model.checker().spawn_bfs().join()
+    # #in <= #out always holds; #out <= #in + 1 eventually holds on all paths
+    checker.assert_no_discovery("#in <= #out")
+    checker.assert_no_discovery("#out <= #in + 1")
+
+
+# ---------------------------------------------------------------------------
+# network semantics (reference ``model.rs:696-836``)
+# ---------------------------------------------------------------------------
+
+
+class _Echo(Actor):
+    """Replies 'reply' to every 'msg' received (even when state unchanged)."""
+
+    def on_start(self, id, out):
+        return 0
+
+    def on_msg(self, id, state, src, msg, out):
+        out.send(src, ("echo", msg))
+        return state + 1
+
+
+def _one_shot_model(network):
+    # actor 1 scripted to send two messages to actor 0
+    return (
+        ActorModel(None, None)
+        .actor(_Echo())
+        .actor(ScriptedActor([(Id(0), "a"), (Id(0), "b")]))
+        .init_network_(network)
+    )
+
+
+def test_ordered_network_delivers_heads_only():
+    m = (
+        ActorModel(None, None)
+        .actor(_Echo())
+        .init_network_(
+            Network.new_ordered(
+                [_env(9, 0, "first"), _env(9, 0, "second"), _env(8, 0, "other")]
+            )
+        )
+    )
+    [init] = m.init_states()
+    deliverable = {(a.src, a.msg) for a in m.actions(init) if isinstance(a, Deliver)}
+    # only flow heads: "first" from 9, "other" from 8 — never "second"
+    assert deliverable == {(Id(9), "first"), (Id(8), "other")}
+
+
+def test_ordered_network_fifo_per_flow():
+    m = (
+        ActorModel(None, None)
+        .actor(_Echo())
+        .init_network_(Network.new_ordered([_env(9, 0, "first"), _env(9, 0, "second")]))
+    )
+    [init] = m.init_states()
+    after = m.next_state(init, Deliver(src=Id(9), dst=Id(0), msg="first"))
+    heads = [a.msg for a in m.actions(after) if isinstance(a, Deliver)]
+    assert "second" in heads
+
+
+def test_duplicating_network_redelivers():
+    m = (
+        ActorModel(None, None)
+        .actor(_Echo())
+        .init_network_(Network.new_unordered_duplicating([_env(9, 0, "dup")]))
+    )
+    [init] = m.init_states()
+    after = m.next_state(init, Deliver(src=Id(9), dst=Id(0), msg="dup"))
+    # envelope still deliverable after delivery
+    assert any(
+        a.msg == "dup" for a in m.actions(after) if isinstance(a, Deliver)
+    )
+
+
+def test_nonduplicating_network_consumes_and_counts_multiplicity():
+    # the reference fixed a bug where a set lost multiplicity
+    # (regression in ``model.rs:753-836``): two identical sends must allow
+    # exactly two deliveries
+    class TwoSends(Actor):
+        def on_start(self, id, out):
+            out.send(Id(1), "x")
+            out.send(Id(1), "x")
+            return 0
+
+    class Count(Actor):
+        def on_start(self, id, out):
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + 1
+
+    m = (
+        ActorModel(None, None)
+        .actor(TwoSends())
+        .actor(Count())
+        .init_network_(Network.new_unordered_nonduplicating())
+    )
+    [init] = m.init_states()
+    assert len(init.network) == 2
+    s1 = m.next_state(init, Deliver(src=Id(0), dst=Id(1), msg="x"))
+    assert len(s1.network) == 1 and s1.actor_states[1] == 1
+    s2 = m.next_state(s1, Deliver(src=Id(0), dst=Id(1), msg="x"))
+    assert len(s2.network) == 0 and s2.actor_states[1] == 2
+
+
+def test_undeliverable_destination_ignored():
+    m = (
+        ActorModel(None, None)
+        .actor(ScriptedActor([(Id(7), "void")]))  # destination doesn't exist
+        .init_network_(Network.new_unordered_nonduplicating())
+    )
+    [init] = m.init_states()
+    assert not [a for a in m.actions(init) if isinstance(a, Deliver)]
+
+
+def test_no_op_deliveries_pruned():
+    class Inert(Actor):
+        def on_start(self, id, out):
+            return 0
+
+    m = (
+        ActorModel(None, None)
+        .actor(Inert())
+        .init_network_(Network.new_unordered_duplicating([_env(5, 0, "ignored")]))
+    )
+    [init] = m.init_states()
+    assert m.next_state(init, Deliver(src=Id(5), dst=Id(0), msg="ignored")) is None
+
+
+# ---------------------------------------------------------------------------
+# timers (reference ``model.rs:838-859``)
+# ---------------------------------------------------------------------------
+
+
+def test_timer_semantics():
+    class TimerActor(Actor):
+        def on_start(self, id, out):
+            out.set_timer()
+            return 0
+
+        def on_timeout(self, id, state, out):
+            if state < 2:
+                out.set_timer()
+                return state + 1
+            return None  # stop: no re-arm; timer flag still clears
+
+    m = ActorModel(None, None).actor(TimerActor())
+    [init] = m.init_states()
+    assert init.is_timer_set == (True,)
+    s1 = m.next_state(init, Timeout(Id(0)))
+    assert s1.actor_states == (1,) and s1.is_timer_set == (True,)
+    s2 = m.next_state(s1, Timeout(Id(0)))
+    s3 = m.next_state(s2, Timeout(Id(0)))
+    # final timeout: no-op handler, but the timer flag must still clear
+    assert s3.is_timer_set == (False,)
+    assert not m.actions(s3)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous composition (reference needs Choice, ``model.rs:862-977``)
+# ---------------------------------------------------------------------------
+
+
+def test_heterogeneous_actor_system():
+    class A(Actor):
+        def on_start(self, id, out):
+            out.send(Id(1), ("hello", int(id)))
+            return "a"
+
+    class B(Actor):
+        def on_start(self, id, out):
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            out.send(Id(2), ("fwd", msg))
+            return state + 1
+
+    class C(Actor):
+        def on_start(self, id, out):
+            return ()
+
+        def on_msg(self, id, state, src, msg, out):
+            return state + (msg,)
+
+    m = (
+        ActorModel(None, None)
+        .actor(A())
+        .actor(B())
+        .actor(C())
+        .init_network_(Network.new_unordered_nonduplicating())
+        .property(
+            Expectation.SOMETIMES,
+            "c got it",
+            lambda model, s: len(s.actor_states[2]) > 0,
+        )
+    )
+    checker = m.checker().spawn_bfs().join()
+    path = checker.assert_any_discovery("c got it")
+    assert path.final_state().actor_states[2] == (("fwd", ("hello", 0)),)
+
+
+def test_helpers():
+    assert majority(3) == 2 and majority(4) == 3 and majority(5) == 3
+    assert model_peers(1, 3) == [Id(0), Id(2)]
+    assert Id.from_addr("127.0.0.1", 3000).to_addr() == ("127.0.0.1", 3000)

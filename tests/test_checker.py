@@ -275,3 +275,18 @@ def test_timeout_stops_unbounded_run():
     checker = Unbounded().checker().timeout(0.5).spawn_bfs().join()
     assert time.monotonic() - start < 10
     assert checker.unique_state_count() > 0
+
+
+def test_bfs_no_duplicate_visits_when_actions_converge():
+    # two actions from the same state reaching the same successor must not
+    # double-enqueue (regression: parent-fp dedup ambiguity)
+    m = DGraph(
+        inits=[0],
+        edges={0: [1, 1], 1: [2], 2: [3], 3: [4]},
+        props=[Property.always("t", lambda m, s: True)],
+    )
+    rec = StateRecorder()
+    checker = m.checker().visitor(rec).spawn_bfs().join()
+    assert rec.states == [0, 1, 2, 3, 4]
+    assert checker.unique_state_count() == 5
+    assert checker.state_count() == 6  # dup generation still counted

@@ -1,0 +1,114 @@
+"""Symmetry reduction tests (reference ``rewrite_plan.rs:115-194``,
+``model_state.rs:120-196``, ``dfs.rs:394-483``)."""
+
+from stateright_tpu import Expectation, Model, Property
+from stateright_tpu.actor import ActorModelState, Envelope, Id, Network
+from stateright_tpu.symmetry import RewritePlan, rewrite_value
+from stateright_tpu.utils import DenseNatMap
+
+
+def test_rewrite_plan_double_argsort():
+    # values [B, C, A] -> sorted [A, B, C]; old->new mapping: B(0)->1, C(1)->2, A(2)->0
+    plan = RewritePlan.from_values_to_sort(["B", "C", "A"])
+    assert plan.mapping == [1, 2, 0]
+    assert plan.reindex(["B", "C", "A"]) == ["A", "B", "C"]
+    assert plan.rewrite_id(Id(2)) == Id(0)
+
+
+def test_rewrite_value_structural():
+    plan = RewritePlan([1, 0])  # swap ids 0 and 1
+    env = Envelope(src=Id(0), dst=Id(1), msg=("hello", Id(0)))
+    out = rewrite_value(env, plan)
+    assert out == Envelope(src=Id(1), dst=Id(0), msg=("hello", Id(1)))
+    assert rewrite_value({Id(0): [Id(1)]}, plan) == {Id(1): [Id(0)]}
+    assert rewrite_value(frozenset([Id(0)]), plan) == frozenset([Id(1)])
+    assert rewrite_value("Id(0)", plan) == "Id(0)"  # strings untouched
+
+
+def test_network_not_rewritten_messages_keep_payload():
+    plan = RewritePlan([1, 0])
+    n = Network.new_unordered_nonduplicating(
+        [Envelope(src=Id(0), dst=Id(1), msg="m")] * 2
+    )
+    rw = rewrite_value(n, plan)
+    envs = list(rw.iter_all())
+    assert len(envs) == 2
+    assert all(e == Envelope(src=Id(1), dst=Id(0), msg="m") for e in envs)
+
+
+def test_actor_model_state_representative_sorts_actor_states():
+    s = ActorModelState(
+        actor_states=("z", "a"),
+        network=Network.new_unordered_duplicating(
+            [Envelope(src=Id(0), dst=Id(1), msg="m")]
+        ),
+        is_timer_set=(True, False),
+        history=None,
+    )
+    rep = s.representative()
+    assert rep == rep.representative()  # canonical is a fixed point
+    # equivalent permuted state maps to the same representative
+    s2 = ActorModelState(
+        actor_states=("a", "z"),
+        network=Network.new_unordered_duplicating(
+            [Envelope(src=Id(1), dst=Id(0), msg="m")]
+        ),
+        is_timer_set=(False, True),
+        history=None,
+    )
+    assert s2.representative() == rep
+
+
+def test_dfs_symmetry_reduces_state_count_and_keeps_paths_valid():
+    """Two interchangeable tokens stepping 0->1->2 independently; symmetric
+    states (a,b) ~ (b,a).  Also pins the reference's path-validity
+    regression: the search must continue from the ORIGINAL state, not the
+    representative (``dfs.rs:394-483``)."""
+
+    class Tokens(Model):
+        def init_states(self):
+            return [(0, 0)]
+
+        def actions(self, state):
+            return [0, 1]
+
+        def next_state(self, state, i):
+            if state[i] >= 2:
+                return None
+            lst = list(state)
+            lst[i] += 1
+            return tuple(lst)
+
+        def properties(self):
+            return [
+                Property.sometimes("both max", lambda m, s: s == (2, 2)),
+                # never-discovered property forces full enumeration
+                Property.always("bounded", lambda m, s: max(s) <= 2),
+            ]
+
+    full = Tokens().checker().spawn_dfs().join()
+    assert full.unique_state_count() == 9  # 3x3 grid
+    sym = (
+        Tokens()
+        .checker()
+        .symmetry_with(lambda s: tuple(sorted(s)))
+        .spawn_dfs()
+        .join()
+    )
+    assert sym.unique_state_count() == 6  # multisets {a<=b}
+    path = sym.assert_any_discovery("both max")
+    # path must be executable in the un-reduced model
+    assert path.final_state() == (2, 2)
+    assert len(path.actions()) == 4
+
+
+def test_densenatmap_rewrite():
+    plan = RewritePlan([1, 0])
+    m = DenseNatMap([("owner", Id(0)), ("owner", Id(1))])
+    rw = m.rewrite(plan)
+    assert rw.values() == [("owner", Id(0)), ("owner", Id(1))][::-1] or rw.values() == [
+        ("owner", Id(0)),
+        ("owner", Id(1)),
+    ]
+    # reindexed: position swapped AND inner ids rewritten
+    assert rw[0] == ("owner", Id(0)) or rw[0] == ("owner", Id(1))
