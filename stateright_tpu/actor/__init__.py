@@ -162,7 +162,16 @@ class Actor:
     def deserialize(self, data: bytes):
         import json
 
-        return json.loads(data.decode())
+        def tuplize(v):
+            if isinstance(v, list):
+                return tuple(tuplize(x) for x in v)
+            if isinstance(v, dict):
+                return {k: tuplize(x) for k, x in v.items()}
+            return v
+
+        # JSON arrays become tuples so wire messages compare equal to the
+        # tuples used in model checking
+        return tuplize(json.loads(data.decode()))
 
 
 @dataclass

@@ -63,11 +63,14 @@ def _run(handle: SpawnedActor) -> None:
                 if timer_deadline is not None
                 else _PRACTICALLY_NEVER
             )
-            sock.settimeout(min(timeout, 0.2))  # 0.2s tick to observe stop()
+            # clamp to a small positive value: settimeout(0) would switch
+            # the socket to non-blocking and make recvfrom raise
+            # BlockingIOError instead of timing out
+            sock.settimeout(min(max(timeout, 0.001), 0.2))
             out = Out()
             try:
                 data, addr = sock.recvfrom(65536)
-            except socket.timeout:
+            except (socket.timeout, BlockingIOError):
                 if (
                     timer_deadline is not None
                     and time.monotonic() >= timer_deadline

@@ -1,0 +1,46 @@
+"""Write-once register harness (reference ``src/actor/write_once_register.rs``).
+
+Same vocabulary as :mod:`.register` plus a ``("put_fail", req_id)`` reply
+mapping to the spec's ``("write_fail",)``; the client additionally treats
+``put_fail`` as acknowledging its put.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import Id
+from .register import (  # shared vocabulary + recorder
+    Get,
+    GetOk,
+    Internal,
+    NULL_VALUE,
+    Put,
+    PutOk,
+    RegisterClient,
+    RegisterClientState,
+    record_invocations,
+    value_chosen,
+)
+from .register import record_returns as _record_returns
+
+
+def PutFail(req_id) -> tuple:
+    return ("put_fail", req_id)
+
+
+def record_returns(cfg, history, env):
+    if env.msg[0] == "put_fail":
+        return history.on_return(env.dst, ("write_fail",))
+    return _record_returns(cfg, history, env)
+
+
+@dataclass
+class WORegisterClient(RegisterClient):
+    """Same workload as :class:`RegisterClient`, tolerating ``put_fail``
+    (reference ``write_once_register.rs:119-241``)."""
+
+    put_reply_kinds = ("put_ok", "put_fail")
+
+
+WORegisterClientState = RegisterClientState

@@ -1,0 +1,23 @@
+"""Example systems (reference L8, ``examples/*.rs``) — the benchmark and
+validation workloads.  Each module exposes a model builder and a CLI
+(``python -m stateright_tpu.models.<name> check ...``) matching the
+reference's argument shapes (e.g. ``examples/paxos.rs:314-395``).
+
+| module | system | pinned unique states |
+|---|---|---|
+| two_phase_commit | abstract 2PC (Gray/Lamport TLA model) | 288 @ 3 RMs; 8,832 @ 5; 665 @ 5 w/ symmetry |
+| paxos | single-decree Paxos + linearizability | 16,668 @ 2 clients / 3 servers |
+| linearizable_register | ABD quorum register | 544 @ 2 clients / 2 servers |
+| single_copy_register | unreplicated register (violation demo) | 93 @ 1 server; 20 @ 2 servers |
+| increment | racy shared counter | 13 / 8 with symmetry (2 threads) |
+| increment_lock | counter with lock | mutex + fin hold |
+"""
+
+__all__ = [
+    "two_phase_commit",
+    "paxos",
+    "linearizable_register",
+    "single_copy_register",
+    "increment",
+    "increment_lock",
+]

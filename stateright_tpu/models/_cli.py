@@ -1,0 +1,37 @@
+"""Shared CLI plumbing for example models (reference per-example ``main()``,
+e.g. ``examples/paxos.rs:314-395``): subcommands ``check [args]``,
+``check-sym``, ``explore [addr]``, ``spawn``, with positional arguments."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Optional
+
+
+def run_cli(
+    usage: str,
+    check: Callable[[list], None],
+    check_sym: Optional[Callable[[list], None]] = None,
+    explore: Optional[Callable[[list], None]] = None,
+    spawn: Optional[Callable[[list], None]] = None,
+    argv: Optional[list] = None,
+) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    cmd = argv[0] if argv else None
+    rest = argv[1:]
+    if cmd == "check":
+        check(rest)
+    elif cmd == "check-sym" and check_sym is not None:
+        check_sym(rest)
+    elif cmd == "explore" and explore is not None:
+        explore(rest)
+    elif cmd == "spawn" and spawn is not None:
+        spawn(rest)
+    else:
+        print("USAGE:")
+        print(usage)
+
+
+def default_threads() -> int:
+    return os.cpu_count() or 1

@@ -1,0 +1,92 @@
+"""Racy shared counter (reference ``examples/increment.rs``).
+
+N threads each run ``read; write(local+1)`` without synchronization; the
+``always "fin"`` property — the counter equals the number of finished threads
+— is violated by interleaved read-modify-write races.  The docstring of the
+reference enumerates the full 13-state space at 2 threads and its 8-state
+symmetric reduction (``increment.rs:36-105``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from .. import Model, Property
+from ._cli import default_threads, run_cli
+
+
+@dataclass(frozen=True)
+class IncState:
+    i: int  # shared counter
+    s: tuple  # per-thread (local value t, program counter pc)
+
+    def representative(self) -> "IncState":
+        return IncState(i=self.i, s=tuple(sorted(self.s)))
+
+
+@dataclass
+class Increment(Model):
+    thread_count: int
+
+    def init_states(self):
+        return [IncState(i=0, s=((0, 1),) * self.thread_count)]
+
+    def actions(self, state: IncState):
+        acts = []
+        for n, (_t, pc) in enumerate(state.s):
+            if pc == 1:
+                acts.append(("read", n))
+            elif pc == 2:
+                acts.append(("write", n))
+        return acts
+
+    def next_state(self, state: IncState, action):
+        kind, n = action
+        s = list(state.s)
+        if kind == "read":
+            s[n] = (state.i, 2)
+            return replace(state, s=tuple(s))
+        t, _pc = s[n]
+        s[n] = (t, 3)
+        return IncState(i=(t + 1) % 256, s=tuple(s))
+
+    def properties(self):
+        return [
+            Property.always(
+                "fin",
+                lambda m, st: sum(1 for _t, pc in st.s if pc == 3) == st.i,
+            )
+        ]
+
+
+def main(argv=None):
+    def check(rest):
+        n = int(rest[0]) if rest else 3
+        print(f"Model checking increment with {n} threads.")
+        Increment(n).checker().threads(default_threads()).spawn_dfs().report()
+
+    def check_sym(rest):
+        n = int(rest[0]) if rest else 3
+        print(f"Model checking increment with {n} threads using symmetry reduction.")
+        Increment(n).checker().threads(
+            default_threads()
+        ).symmetry().spawn_dfs().report()
+
+    def explore(rest):
+        n = int(rest[0]) if rest else 3
+        addr = rest[1] if len(rest) > 1 else "localhost:3000"
+        Increment(n).checker().serve(addr)
+
+    run_cli(
+        "  increment check [THREAD_COUNT]\n"
+        "  increment check-sym [THREAD_COUNT]\n"
+        "  increment explore [THREAD_COUNT] [ADDRESS]",
+        check,
+        check_sym=check_sym,
+        explore=explore,
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Shared counter with a lock (reference ``examples/increment_lock.rs``).
+
+Same as :mod:`.increment` but each thread takes a global lock around its
+read-modify-write, so ``always "fin"`` holds, and ``always "mutex"`` pins
+that at most one thread is in the critical section.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from .. import Model, Property
+from ._cli import default_threads, run_cli
+
+
+@dataclass(frozen=True)
+class LockState:
+    i: int
+    lock: bool
+    s: tuple  # per-thread (local value t, program counter pc)
+
+    def representative(self) -> "LockState":
+        return LockState(i=self.i, lock=self.lock, s=tuple(sorted(self.s)))
+
+
+@dataclass
+class IncrementLock(Model):
+    thread_count: int
+
+    def init_states(self):
+        return [LockState(i=0, lock=False, s=((0, 0),) * self.thread_count)]
+
+    def actions(self, state: LockState):
+        acts = []
+        for n, (_t, pc) in enumerate(state.s):
+            if pc == 0 and not state.lock:
+                acts.append(("lock", n))
+            elif pc == 1:
+                acts.append(("read", n))
+            elif pc == 2:
+                acts.append(("write", n))
+            elif pc == 3 and state.lock:
+                acts.append(("release", n))
+        return acts
+
+    def next_state(self, state: LockState, action):
+        kind, n = action
+        s = list(state.s)
+        t, pc = s[n]
+        if kind == "lock":
+            s[n] = (t, 1)
+            return replace(state, s=tuple(s), lock=True)
+        if kind == "read":
+            s[n] = (state.i, 2)
+            return replace(state, s=tuple(s))
+        if kind == "write":
+            s[n] = (t, 3)
+            return replace(state, s=tuple(s), i=(t + 1) % 256)
+        s[n] = (t, 4)
+        return replace(state, s=tuple(s), lock=False)
+
+    def properties(self):
+        return [
+            Property.always(
+                "fin",
+                lambda m, st: sum(1 for _t, pc in st.s if pc >= 3) == st.i,
+            ),
+            Property.always(
+                "mutex",
+                lambda m, st: sum(1 for _t, pc in st.s if 1 <= pc < 4) <= 1,
+            ),
+        ]
+
+
+def main(argv=None):
+    def check(rest):
+        n = int(rest[0]) if rest else 3
+        print(f"Model checking increment-lock with {n} threads.")
+        IncrementLock(n).checker().threads(default_threads()).spawn_dfs().report()
+
+    def check_sym(rest):
+        n = int(rest[0]) if rest else 3
+        IncrementLock(n).checker().threads(
+            default_threads()
+        ).symmetry().spawn_dfs().report()
+
+    def explore(rest):
+        n = int(rest[0]) if rest else 3
+        addr = rest[1] if len(rest) > 1 else "localhost:3000"
+        IncrementLock(n).checker().serve(addr)
+
+    run_cli(
+        "  increment_lock check [THREAD_COUNT]\n"
+        "  increment_lock check-sym [THREAD_COUNT]\n"
+        "  increment_lock explore [THREAD_COUNT] [ADDRESS]",
+        check,
+        check_sym=check_sym,
+        explore=explore,
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

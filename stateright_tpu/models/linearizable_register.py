@@ -1,0 +1,250 @@
+"""ABD linearizable quorum register (reference
+``examples/linearizable-register.rs``), after "Sharing Memory Robustly in
+Message-Passing Systems" by Attiya, Bar-Noy, and Dolev.
+
+Each request runs two phases: a query phase establishing the latest
+(sequencer, value) from a majority, then a record phase driving it (or the
+new write, with a bumped sequencer) to a majority.  Sequencers are
+``(logical clock, server id)`` pairs, so they are distinct across servers.
+
+Pinned count (reference ``linearizable-register.rs:258,281``): 544 unique
+states @ 2 clients / 2 servers on an unordered non-duplicating network.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from .. import Expectation
+from ..actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
+from ..actor.register import (
+    NULL_VALUE,
+    GetOk,
+    Internal,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+    value_chosen,
+)
+from ..semantics import LinearizabilityTester, Register
+from ._cli import default_threads, run_cli
+
+
+def Query(req_id):
+    return ("query", req_id)
+
+
+def AckQuery(req_id, seq, value):
+    return ("ack_query", req_id, seq, value)
+
+
+def Record(req_id, seq, value):
+    return ("record", req_id, seq, value)
+
+
+def AckRecord(req_id):
+    return ("ack_record", req_id)
+
+
+@dataclass(frozen=True)
+class AbdPhase1:
+    request_id: int
+    requester_id: Id
+    write: Optional[str]  # value to write, None for reads
+    responses: tuple  # sorted ((server id, (seq, value)), ...)
+
+
+@dataclass(frozen=True)
+class AbdPhase2:
+    request_id: int
+    requester_id: Id
+    read: Optional[str]  # value read in phase 1, None for writes
+    acks: frozenset  # server ids
+
+
+@dataclass(frozen=True)
+class AbdState:
+    seq: tuple  # (logical clock, server id)
+    val: str
+    phase: Optional[object]  # AbdPhase1 | AbdPhase2 | None
+
+
+@dataclass
+class AbdServer(Actor):
+    """One ABD replica (reference ``linearizable-register.rs:56-186``)."""
+
+    peers: list
+
+    def on_start(self, id: Id, out: Out):
+        return AbdState(seq=(0, Id(id)), val=NULL_VALUE, phase=None)
+
+    def _quorum(self) -> int:
+        return majority(len(self.peers) + 1)
+
+    def on_msg(self, id: Id, state: AbdState, src: Id, msg, out: Out):
+        kind = msg[0]
+
+        if kind in ("put", "get") and state.phase is None:
+            req_id = msg[1]
+            out.broadcast(self.peers, Internal(Query(req_id)))
+            return replace(
+                state,
+                phase=AbdPhase1(
+                    request_id=req_id,
+                    requester_id=Id(src),
+                    write=msg[2] if kind == "put" else None,
+                    responses=((Id(id), (state.seq, state.val)),),
+                ),
+            )
+
+        if kind != "internal":
+            return None
+        imsg = msg[1]
+        ikind = imsg[0]
+
+        if ikind == "query":
+            out.send(src, Internal(AckQuery(imsg[1], state.seq, state.val)))
+            return state
+
+        if ikind == "ack_query":
+            req_id, seq, val = imsg[1], imsg[2], imsg[3]
+            ph = state.phase
+            if not (isinstance(ph, AbdPhase1) and ph.request_id == req_id):
+                return None
+            responses = dict(ph.responses)
+            responses[Id(src)] = (seq, val)
+            resp_tuple = tuple(sorted(responses.items()))
+            if len(resp_tuple) == self._quorum():
+                # quorum: pick latest (sequencers are distinct), move to
+                # phase 2 (reference ``linearizable-register.rs:107-147``)
+                best_seq, best_val = max(
+                    responses.values(), key=lambda sv: sv[0]
+                )
+                if ph.write is not None:
+                    new_seq = (best_seq[0] + 1, Id(id))
+                    new_val = ph.write
+                    read = None
+                else:
+                    new_seq, new_val = best_seq, best_val
+                    read = best_val
+                out.broadcast(
+                    self.peers, Internal(Record(req_id, new_seq, new_val))
+                )
+                # self-send Record
+                seq2, val2 = state.seq, state.val
+                if new_seq > state.seq:
+                    seq2, val2 = new_seq, new_val
+                return replace(
+                    state,
+                    seq=seq2,
+                    val=val2,
+                    phase=AbdPhase2(
+                        request_id=req_id,
+                        requester_id=ph.requester_id,
+                        read=read,
+                        acks=frozenset({Id(id)}),
+                    ),
+                )
+            return replace(state, phase=replace(ph, responses=resp_tuple))
+
+        if ikind == "record":
+            req_id, seq, val = imsg[1], imsg[2], imsg[3]
+            out.send(src, Internal(AckRecord(req_id)))
+            if seq > state.seq:
+                return replace(state, seq=seq, val=val)
+            return state
+
+        if ikind == "ack_record":
+            req_id = imsg[1]
+            ph = state.phase
+            if not (
+                isinstance(ph, AbdPhase2)
+                and ph.request_id == req_id
+                and Id(src) not in ph.acks
+            ):
+                return None
+            acks = ph.acks | {Id(src)}
+            if len(acks) == self._quorum():
+                if ph.read is not None:
+                    out.send(ph.requester_id, GetOk(req_id, ph.read))
+                else:
+                    out.send(ph.requester_id, PutOk(req_id))
+                return replace(state, phase=None)
+            return replace(state, phase=replace(ph, acks=acks))
+
+        return None
+
+
+def abd_model(
+    client_count: int, server_count: int = 2, network: Optional[Network] = None
+) -> ActorModel:
+    """Build the checked system (reference ``linearizable-register.rs:195-230``)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+    m = ActorModel(
+        cfg=None, init_history=LinearizabilityTester(Register(NULL_VALUE))
+    )
+    for i in range(server_count):
+        m.actor(AbdServer(peers=model_peers(i, server_count)))
+    for _ in range(client_count):
+        m.actor(RegisterClient(put_count=1, server_count=server_count))
+    m.init_network_(network)
+    m.property(
+        Expectation.ALWAYS,
+        "linearizable",
+        lambda model, s: s.history.is_consistent(),
+    )
+    m.property(Expectation.SOMETIMES, "value chosen", value_chosen)
+    m.record_msg_in(record_returns)
+    m.record_msg_out(record_invocations)
+    return m
+
+
+def main(argv=None):
+    def check(rest):
+        client_count = int(rest[0]) if rest else 2
+        network = (
+            Network.from_name(rest[1])
+            if len(rest) > 1
+            else Network.new_unordered_nonduplicating()
+        )
+        print(f"Model checking a linearizable register with {client_count} clients.")
+        abd_model(client_count, 2, network).checker().threads(
+            default_threads()
+        ).spawn_bfs().report()
+
+    def explore(rest):
+        client_count = int(rest[0]) if rest else 2
+        addr = rest[1] if len(rest) > 1 else "localhost:3000"
+        print(f"Exploring ABD state space with {client_count} clients on {addr}.")
+        abd_model(client_count, 2).checker().serve(addr)
+
+    def spawn_cmd(rest):
+        from ..actor import spawn
+
+        ids = [Id.from_addr("127.0.0.1", 3000 + i) for i in range(2)]
+        for id in ids:
+            print(f"  Server listening on {id.to_addr()}")
+        spawn(
+            [
+                (id, AbdServer(peers=[p for p in ids if p != id]))
+                for id in ids
+            ],
+            background=False,
+        )
+
+    run_cli(
+        "  linearizable_register check [CLIENT_COUNT] [NETWORK]\n"
+        "  linearizable_register explore [CLIENT_COUNT] [ADDRESS]\n"
+        "  linearizable_register spawn",
+        check,
+        explore=explore,
+        spawn=spawn_cmd,
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

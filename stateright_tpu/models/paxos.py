@@ -1,0 +1,259 @@
+"""Single-decree Paxos under linearizability checking
+(reference ``examples/paxos.rs``).
+
+Each server is simultaneously a potential leader (proposer) and an acceptor.
+A client ``put`` triggers a new ballot: the leader broadcasts ``prepare``,
+collects a majority of ``prepared`` replies (adopting the most recently
+accepted proposal if any), broadcasts ``accept``, and on a majority of
+``accepted`` declares the value decided, replying ``put_ok`` and broadcasting
+``decided``.  Clients then ``get``; servers only answer once decided.
+
+The model wires :class:`~stateright_tpu.actor.register.RegisterClient`
+workloads and a :class:`~stateright_tpu.semantics.LinearizabilityTester`
+history; the ``linearizable`` property runs the interleaving search per state.
+
+Pinned count (reference ``examples/paxos.rs:291,311``): 16,668 unique states
+@ 2 clients / 3 servers on an unordered non-duplicating network.
+This workload is the driver's primary benchmark (``paxos check 3``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from .. import Expectation
+from ..actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
+from ..actor.register import (
+    NULL_VALUE,
+    GetOk,
+    Internal,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+    value_chosen,
+)
+from ..semantics import LinearizabilityTester, Register
+from ._cli import default_threads, run_cli
+
+def _ballot_zero() -> tuple:
+    return (0, Id(0))
+
+
+@dataclass(frozen=True)
+class PaxosState:
+    """Per-server state (reference ``paxos.rs:78-91``)."""
+
+    ballot: tuple  # (round, leader id)
+    # leader state
+    proposal: Optional[tuple]  # (request id, requester id, value)
+    prepares: tuple  # sorted ((acceptor id, last_accepted), ...)
+    accepts: frozenset  # acceptor ids
+    # acceptor state
+    accepted: Optional[tuple]  # (ballot, proposal)
+    is_decided: bool
+
+
+def _accepted_key(last_accepted):
+    """Total order on Option<(Ballot, Proposal)> matching the reference's
+    ``max`` over ``prepares.values()`` (None is least)."""
+    if last_accepted is None:
+        return (0,)
+    return (1, last_accepted)
+
+
+@dataclass
+class PaxosServer(Actor):
+    """One Paxos server (reference ``paxos.rs:96-222``)."""
+
+    peer_ids: list
+
+    def on_start(self, id: Id, out: Out):
+        return PaxosState(
+            ballot=_ballot_zero(),
+            proposal=None,
+            prepares=(),
+            accepts=frozenset(),
+            accepted=None,
+            is_decided=False,
+        )
+
+    def on_msg(self, id: Id, state: PaxosState, src: Id, msg, out: Out):
+        kind = msg[0]
+        if state.is_decided:
+            if kind == "get":
+                # A server that hasn't decided doesn't know whether a value
+                # was decided elsewhere, so it never replies "no value"
+                # (reference ``paxos.rs:117-129``).
+                _ballot, proposal = state.accepted
+                out.send(src, GetOk(msg[1], proposal[2]))
+                return state  # reference registers a (possibly no-op) change
+            return None
+
+        if kind == "put" and state.proposal is None:
+            req_id, value = msg[1], msg[2]
+            ballot = (state.ballot[0] + 1, Id(id))
+            out.broadcast(self.peer_ids, Internal(("prepare", ballot)))
+            return replace(
+                state,
+                ballot=ballot,
+                proposal=(req_id, Id(src), value),
+                prepares=((Id(id), state.accepted),),  # self-send Prepared
+                accepts=frozenset(),
+            )
+
+        if kind != "internal":
+            return None
+        imsg = msg[1]
+        ikind = imsg[0]
+
+        if ikind == "prepare":
+            ballot = imsg[1]
+            if state.ballot < ballot:
+                out.send(src, Internal(("prepared", ballot, state.accepted)))
+                return replace(state, ballot=ballot)
+            return None
+
+        if ikind == "prepared":
+            ballot, last_accepted = imsg[1], imsg[2]
+            if ballot != state.ballot:
+                return None
+            prepares = dict(state.prepares)
+            prepares[Id(src)] = last_accepted
+            new_prepares = tuple(sorted(prepares.items()))
+            new_state = replace(state, prepares=new_prepares)
+            quorum = majority(len(self.peer_ids) + 1)
+            if len(new_prepares) == quorum:
+                # leadership handoff: favor the most recently accepted
+                # proposal from the prepare quorum (reference
+                # ``paxos.rs:158-179``)
+                best = max(
+                    (la for _, la in new_prepares), key=_accepted_key
+                )
+                proposal = best[1] if best is not None else state.proposal
+                out.broadcast(
+                    self.peer_ids, Internal(("accept", ballot, proposal))
+                )
+                new_state = replace(
+                    new_state,
+                    proposal=proposal,
+                    accepted=(ballot, proposal),  # self-send Accept
+                    accepts=frozenset({Id(id)}),  # self-send Accepted
+                )
+            return new_state
+
+        if ikind == "accept":
+            ballot, proposal = imsg[1], imsg[2]
+            if state.ballot <= ballot:
+                out.send(src, Internal(("accepted", ballot)))
+                return replace(
+                    state, ballot=ballot, accepted=(ballot, proposal)
+                )
+            return None
+
+        if ikind == "accepted":
+            ballot = imsg[1]
+            if ballot != state.ballot:
+                return None
+            accepts = state.accepts | {Id(src)}
+            new_state = replace(state, accepts=accepts)
+            quorum = majority(len(self.peer_ids) + 1)
+            if len(accepts) == quorum:
+                proposal = state.proposal
+                out.broadcast(
+                    self.peer_ids, Internal(("decided", ballot, proposal))
+                )
+                req_id, requester_id, _value = proposal
+                out.send(requester_id, PutOk(req_id))
+                new_state = replace(new_state, is_decided=True)
+            return new_state
+
+        if ikind == "decided":
+            ballot, proposal = imsg[1], imsg[2]
+            return replace(
+                state,
+                ballot=ballot,
+                accepted=(ballot, proposal),
+                is_decided=True,
+            )
+
+        return None
+
+
+def paxos_model(
+    client_count: int, server_count: int = 3, network: Optional[Network] = None
+) -> ActorModel:
+    """Build the checked system (reference ``paxos.rs:231-266``)."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+    m = ActorModel(
+        cfg=None, init_history=LinearizabilityTester(Register(NULL_VALUE))
+    )
+    for i in range(server_count):
+        m.actor(PaxosServer(peer_ids=model_peers(i, server_count)))
+    for _ in range(client_count):
+        m.actor(RegisterClient(put_count=1, server_count=server_count))
+    m.init_network_(network)
+    m.property(
+        Expectation.ALWAYS,
+        "linearizable",
+        lambda model, s: s.history.is_consistent(),
+    )
+    m.property(Expectation.SOMETIMES, "value chosen", value_chosen)
+    m.record_msg_in(record_returns)
+    m.record_msg_out(record_invocations)
+    return m
+
+
+def main(argv=None):
+    def check(rest):
+        client_count = int(rest[0]) if rest else 2
+        network = (
+            Network.from_name(rest[1])
+            if len(rest) > 1
+            else Network.new_unordered_nonduplicating()
+        )
+        print(f"Model checking Single Decree Paxos with {client_count} clients.")
+        paxos_model(client_count, 3, network).checker().threads(
+            default_threads()
+        ).spawn_dfs().report()
+
+    def explore(rest):
+        client_count = int(rest[0]) if rest else 2
+        addr = rest[1] if len(rest) > 1 else "localhost:3000"
+        print(f"Exploring Paxos state space with {client_count} clients on {addr}.")
+        paxos_model(client_count, 3).checker().serve(addr)
+
+    def spawn_cmd(rest):
+        from ..actor import spawn
+
+        ids = [Id.from_addr("127.0.0.1", 3000 + i) for i in range(3)]
+        print("  A set of servers that implement Single Decree Paxos.")
+        print("  You can monitor and interact using tools such as nc or stateright-cli.")
+        for id in ids:
+            print(f"  Server listening on {id.to_addr()}")
+        actors = [
+            (
+                id,
+                PaxosServer(
+                    peer_ids=[p for p in ids if p != id]
+                ),
+            )
+            for id in ids
+        ]
+        spawn(actors, background=False)
+
+    run_cli(
+        "  paxos check [CLIENT_COUNT] [NETWORK]\n"
+        "  paxos explore [CLIENT_COUNT] [ADDRESS]\n"
+        "  paxos spawn",
+        check,
+        explore=explore,
+        spawn=spawn_cmd,
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Two-phase commit, after the Gray/Lamport TLA+ model "Consensus on
+Transaction Commit" (reference ``examples/2pc.rs``).
+
+A transaction manager (TM) coordinates N resource managers (RMs).  The
+abstract model tracks each RM's state, the TM's state, which RMs the TM has
+seen prepared, and a monotonic message set.  Properties: commit/abort
+agreement is reachable (`sometimes`) and no RM ever aborts while another
+commits (`always consistent`).
+
+This is also the framework's flagship tensor-form model: see
+``parallel/models/two_phase_commit.py`` for the u64-row encoding checked by
+the TPU wavefront engine; both forms agree on fingerprints.
+
+Pinned counts (reference ``examples/2pc.rs:125-140``): 288 @ 3 RMs,
+8,832 @ 5 RMs, 665 @ 5 RMs with symmetry reduction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from .. import Model, Property
+from ..symmetry import RewritePlan
+from ._cli import default_threads, run_cli
+
+# RM states, ordered so sorting gives a canonical symmetry representative
+WORKING = "working"
+PREPARED = "prepared"
+COMMITTED = "committed"
+ABORTED = "aborted"
+
+# TM states
+TM_INIT = "init"
+TM_COMMITTED = "committed"
+TM_ABORTED = "aborted"
+
+
+@dataclass(frozen=True)
+class TwoPhaseState:
+    rm_state: tuple  # one of the RM states per RM
+    tm_state: str
+    tm_prepared: tuple  # bool per RM
+    msgs: frozenset  # ("prepared", rm) | ("commit",) | ("abort",)
+
+    def representative(self) -> "TwoPhaseState":
+        """Sort RM states (with their tm_prepared flags) and rewrite RM
+        indices inside messages (reference ``2pc.rs:165-182``)."""
+        plan = RewritePlan.from_values_to_sort(self.rm_state)
+        return TwoPhaseState(
+            rm_state=tuple(plan.reindex(self.rm_state)),
+            tm_state=self.tm_state,
+            tm_prepared=tuple(plan.reindex(self.tm_prepared)),
+            msgs=frozenset(
+                ("prepared", plan.mapping[m[1]]) if m[0] == "prepared" else m
+                for m in self.msgs
+            ),
+        )
+
+
+@dataclass
+class TwoPhaseSys(Model):
+    """Abstract 2PC over ``rm_count`` resource managers
+    (reference ``2pc.rs:43-121``)."""
+
+    rm_count: int
+
+    def init_states(self):
+        n = self.rm_count
+        return [
+            TwoPhaseState(
+                rm_state=(WORKING,) * n,
+                tm_state=TM_INIT,
+                tm_prepared=(False,) * n,
+                msgs=frozenset(),
+            )
+        ]
+
+    def actions(self, state: TwoPhaseState):
+        acts = []
+        if state.tm_state == TM_INIT and all(state.tm_prepared):
+            acts.append(("tm_commit",))
+        if state.tm_state == TM_INIT:
+            acts.append(("tm_abort",))
+        for rm in range(self.rm_count):
+            if state.tm_state == TM_INIT and ("prepared", rm) in state.msgs:
+                acts.append(("tm_rcv_prepared", rm))
+            if state.rm_state[rm] == WORKING:
+                acts.append(("rm_prepare", rm))
+                acts.append(("rm_choose_abort", rm))
+            if ("commit",) in state.msgs:
+                acts.append(("rm_rcv_commit", rm))
+            if ("abort",) in state.msgs:
+                acts.append(("rm_rcv_abort", rm))
+        return acts
+
+    def next_state(self, state: TwoPhaseState, action) -> Optional[TwoPhaseState]:
+        kind = action[0]
+        if kind == "tm_rcv_prepared":
+            rm = action[1]
+            prepared = list(state.tm_prepared)
+            prepared[rm] = True
+            return replace(state, tm_prepared=tuple(prepared))
+        if kind == "tm_commit":
+            return replace(
+                state, tm_state=TM_COMMITTED, msgs=state.msgs | {("commit",)}
+            )
+        if kind == "tm_abort":
+            return replace(
+                state, tm_state=TM_ABORTED, msgs=state.msgs | {("abort",)}
+            )
+        rm = action[1]
+        rm_state = list(state.rm_state)
+        if kind == "rm_prepare":
+            rm_state[rm] = PREPARED
+            return replace(
+                state,
+                rm_state=tuple(rm_state),
+                msgs=state.msgs | {("prepared", rm)},
+            )
+        if kind == "rm_choose_abort":
+            rm_state[rm] = ABORTED
+        elif kind == "rm_rcv_commit":
+            rm_state[rm] = COMMITTED
+        elif kind == "rm_rcv_abort":
+            rm_state[rm] = ABORTED
+        else:
+            raise ValueError(action)
+        return replace(state, rm_state=tuple(rm_state))
+
+    def properties(self):
+        return [
+            Property.sometimes(
+                "abort agreement",
+                lambda m, s: all(x == ABORTED for x in s.rm_state),
+            ),
+            Property.sometimes(
+                "commit agreement",
+                lambda m, s: all(x == COMMITTED for x in s.rm_state),
+            ),
+            Property.always(
+                "consistent",
+                lambda m, s: not (
+                    ABORTED in s.rm_state and COMMITTED in s.rm_state
+                ),
+            ),
+        ]
+
+
+def main(argv=None):
+    def check(rest):
+        rm_count = int(rest[0]) if rest else 2
+        print(f"Checking two phase commit with {rm_count} resource managers.")
+        TwoPhaseSys(rm_count).checker().threads(default_threads()).spawn_dfs().report()
+
+    def check_sym(rest):
+        rm_count = int(rest[0]) if rest else 2
+        print(
+            f"Checking two phase commit with {rm_count} resource managers"
+            " using symmetry reduction."
+        )
+        TwoPhaseSys(rm_count).checker().threads(
+            default_threads()
+        ).symmetry().spawn_dfs().report()
+
+    def check_tpu(rest):
+        rm_count = int(rest[0]) if rest else 2
+        print(f"Checking two phase commit with {rm_count} RMs on TPU.")
+        TwoPhaseSys(rm_count).checker().spawn_tpu().report()
+
+    def explore(rest):
+        rm_count = int(rest[0]) if rest else 2
+        addr = rest[1] if len(rest) > 1 else "localhost:3000"
+        print(f"Exploring 2PC state space with {rm_count} RMs on {addr}.")
+        TwoPhaseSys(rm_count).checker().serve(addr)
+
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "check-tpu":
+        check_tpu(argv[1:])
+        return
+    run_cli(
+        "  two_phase_commit check [RESOURCE_MANAGER_COUNT]\n"
+        "  two_phase_commit check-sym [RESOURCE_MANAGER_COUNT]\n"
+        "  two_phase_commit check-tpu [RESOURCE_MANAGER_COUNT]\n"
+        "  two_phase_commit explore [RESOURCE_MANAGER_COUNT] [ADDRESS]",
+        check,
+        check_sym=check_sym,
+        explore=explore,
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Sequential-consistency tester (reference ``src/semantics/sequential_consistency.rs``).
+
+Identical recording structure to the linearizability tester but without the
+real-time (happens-before) prerequisite snapshots: only per-thread program
+order must be respected by the serialization.  A history can be sequentially
+consistent yet not linearizable (stale reads across threads).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..fingerprint import stable_hash
+from .linearizability import (
+    LinearizabilityTester,
+    _serialize,
+    _VERDICT_CACHE,
+    _VERDICT_CACHE_MAX,
+)
+
+
+class SequentialConsistencyTester(LinearizabilityTester):
+    """Shares recording with LinearizabilityTester; ``_last_completed``
+    snapshots are recorded but ignored during serialization."""
+
+    def serialized_history(self) -> Optional[list]:
+        if not self.valid:
+            return None
+        remaining = {
+            t: tuple(enumerate(cs)) for t, cs in self.history_by_thread.items()
+        }
+        return _serialize(
+            [], self.init_ref_obj, remaining, dict(self.in_flight_by_thread),
+            real_time=False,
+        )
+
+    def is_consistent(self) -> bool:
+        # separate cache namespace from the linearizability verdicts
+        key = stable_hash(("SC", stable_hash(self)))
+        cached = _VERDICT_CACHE.get(key)
+        if cached is None:
+            if len(_VERDICT_CACHE) >= _VERDICT_CACHE_MAX:
+                _VERDICT_CACHE.clear()
+            cached = self.serialized_history() is not None
+            _VERDICT_CACHE[key] = cached
+        return cached

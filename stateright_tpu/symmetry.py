@@ -104,9 +104,3 @@ def rewrite_value(x: Any, plan: RewritePlan) -> Any:
         return x
     return x  # opaque scalars pass through unchanged
 
-
-def sorted_representative(values: Sequence[Any]) -> tuple[list, RewritePlan]:
-    """Sort ``values`` into canonical order (by stable hash, which tolerates
-    unorderable heterogeneous states) and return (sorted, plan)."""
-    plan = RewritePlan.from_values_to_sort(values, key=stable_hash)
-    return plan.reindex(values), plan

@@ -1,0 +1,249 @@
+"""Consistency-semantics tests (reference ``linearizability.rs:268-453``,
+``sequential_consistency.rs:240-344``, spec tests, ORL checked by the model
+checker itself ``ordered_reliable_link.rs:217-244``)."""
+
+import pytest
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    Deliver,
+    Id,
+    Network,
+    Out,
+)
+from stateright_tpu.actor.ordered_reliable_link import OrderedReliableLink
+from stateright_tpu.semantics import (
+    LinearizabilityTester,
+    Register,
+    SequentialConsistencyTester,
+    VecSpec,
+    WORegister,
+)
+from stateright_tpu.semantics.register import READ, write
+
+
+# ---------------------------------------------------------------------------
+# sequential specs
+# ---------------------------------------------------------------------------
+
+def test_register_spec():
+    r = Register("A")
+    r2, ret = r.invoke(READ)
+    assert ret == ("read_ok", "A") and r2 == r
+    r3, ret = r.invoke(write("B"))
+    assert ret == ("write_ok",)
+    _, ret = r3.invoke(READ)
+    assert ret == ("read_ok", "B")
+    assert r.is_valid_history(
+        [(write("B"), ("write_ok",)), (READ, ("read_ok", "B"))]
+    )
+    assert not r.is_valid_history(
+        [(write("B"), ("write_ok",)), (READ, ("read_ok", "A"))]
+    )
+
+
+def test_wo_register_spec():
+    r = WORegister()
+    r2, ret = r.invoke(write("A"))
+    assert ret == ("write_ok",)
+    _, ret = r2.invoke(write("A"))
+    assert ret == ("write_ok",)  # idempotent equal write
+    _, ret = r2.invoke(write("B"))
+    assert ret == ("write_fail",)
+    _, ret = r2.invoke(READ)
+    assert ret == ("read_ok", "A")
+
+
+def test_vec_spec():
+    v = VecSpec(("A",))
+    v, ret = v.invoke(("len",))
+    assert ret == ("len_ok", 1)
+    v, ret = v.invoke(("push", "B"))
+    assert ret == ("push_ok",)
+    v, ret = v.invoke(("pop",))
+    assert ret == ("pop_ok", "B")
+    v, ret = v.invoke(("pop",))
+    assert ret == ("pop_ok", "A")
+    v, ret = v.invoke(("pop",))
+    assert ret == ("pop_ok", None)
+
+
+# ---------------------------------------------------------------------------
+# linearizability (reference ``linearizability.rs:268-453``)
+# ---------------------------------------------------------------------------
+
+def test_linearizable_sequential_history():
+    h = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(0, write("B"), ("write_ok",))
+        .on_invret(0, READ, ("read_ok", "B"))
+    )
+    assert h.is_consistent()
+    assert h.serialized_history() == [
+        (write("B"), ("write_ok",)),
+        (READ, ("read_ok", "B")),
+    ]
+
+
+def test_stale_read_not_linearizable():
+    # T0 writes B and returns; T1 then reads A (the initial value): the
+    # real-time constraint forbids serializing the read before the write
+    h = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(0, write("B"), ("write_ok",))
+        .on_invret(1, READ, ("read_ok", "A"))
+    )
+    assert not h.is_consistent()
+
+
+def test_stale_read_is_sequentially_consistent():
+    # same history IS sequentially consistent (read serialized first)
+    h = (
+        SequentialConsistencyTester(Register("A"))
+        .on_invret(0, write("B"), ("write_ok",))
+        .on_invret(1, READ, ("read_ok", "A"))
+    )
+    assert h.is_consistent()
+    assert h.serialized_history() == [
+        (READ, ("read_ok", "A")),
+        (write("B"), ("write_ok",)),
+    ]
+
+
+def test_concurrent_read_may_see_either_value():
+    # write in flight: concurrent read may see old or new value
+    for seen in ("A", "B"):
+        h = (
+            LinearizabilityTester(Register("A"))
+            .on_invoke(0, write("B"))
+            .on_invret(1, READ, ("read_ok", seen))
+        )
+        assert h.is_consistent(), seen
+
+
+def test_in_flight_op_may_remain_unserialized():
+    h = LinearizabilityTester(Register("A")).on_invoke(0, write("B"))
+    assert h.is_consistent()
+    assert h.serialized_history() == []
+
+
+def test_invalid_history_double_invoke():
+    h = LinearizabilityTester(Register("A")).on_invoke(0, READ)
+    h2 = h.on_invoke(0, READ)  # same thread, op already in flight
+    assert not h2.valid
+    assert not h2.is_consistent()
+    h3 = LinearizabilityTester(Register("A")).on_return(0, ("write_ok",))
+    assert not h3.valid
+
+
+def test_tester_equality_and_hash():
+    a = LinearizabilityTester(Register("A")).on_invret(0, READ, ("read_ok", "A"))
+    b = LinearizabilityTester(Register("A")).on_invret(0, READ, ("read_ok", "A"))
+    assert a == b and hash(a) == hash(b)
+    c = a.on_invoke(1, write("B"))
+    assert a != c
+
+
+def test_real_time_chain_across_three_threads():
+    # T0 writes B; then T1 writes C; then T2 reads — must see C, not B
+    h = (
+        LinearizabilityTester(Register("A"))
+        .on_invret(0, write("B"), ("write_ok",))
+        .on_invret(1, write("C"), ("write_ok",))
+    )
+    assert h.on_invret(2, READ, ("read_ok", "C")).is_consistent()
+    assert not h.on_invret(2, READ, ("read_ok", "B")).is_consistent()
+    assert not h.on_invret(2, READ, ("read_ok", "A")).is_consistent()
+
+
+def test_vec_histories():
+    # pop before push is not linearizable unless concurrent
+    h = (
+        LinearizabilityTester(VecSpec())
+        .on_invret(0, ("pop",), ("pop_ok", "X"))
+        .on_invret(1, ("push", "X"), ("push_ok",))
+    )
+    assert not h.is_consistent()
+    h2 = (
+        LinearizabilityTester(VecSpec())
+        .on_invoke(1, ("push", "X"))
+        .on_invret(0, ("pop",), ("pop_ok", "X"))
+    )
+    assert h2.is_consistent()
+
+
+# ---------------------------------------------------------------------------
+# ordered reliable link, checked by the model checker itself
+# (reference ``ordered_reliable_link.rs:150-244``)
+# ---------------------------------------------------------------------------
+
+class _TestSender(Actor):
+    def __init__(self, receiver_id):
+        self.receiver_id = receiver_id
+
+    def on_start(self, id, out):
+        out.send(self.receiver_id, 42)
+        out.send(self.receiver_id, 43)
+        return ()
+
+    def on_msg(self, id, state, src, msg, out):
+        return state + ((src, msg),)
+
+
+class _TestReceiver(Actor):
+    def on_start(self, id, out):
+        return ()
+
+    def on_msg(self, id, state, src, msg, out):
+        return state + ((src, msg),)
+
+
+def _orl_model():
+    def received(state):
+        return [m for _, m in state.actor_states[1].wrapped_state]
+
+    return (
+        ActorModel(None, None)
+        .actor(OrderedReliableLink(_TestSender(Id(1))))
+        .actor(OrderedReliableLink(_TestReceiver()))
+        .init_network_(Network.new_unordered_duplicating())
+        .lossy_network(True)
+        .property(
+            Expectation.ALWAYS,
+            "no redelivery",
+            lambda m, s: received(s).count(42) < 2 and received(s).count(43) < 2,
+        )
+        .property(
+            Expectation.ALWAYS,
+            "ordered",
+            lambda m, s: received(s) == sorted(received(s)),
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "delivered",
+            lambda m, s: s.actor_states[1].wrapped_state
+            == ((Id(0), 42), (Id(0), 43)),
+        )
+        .within_boundary_(lambda c, s: len(s.network) < 4)
+    )
+
+
+def test_orl_messages_not_delivered_twice():
+    _orl_model().checker().spawn_bfs().join().assert_no_discovery("no redelivery")
+
+
+def test_orl_messages_delivered_in_order():
+    _orl_model().checker().spawn_bfs().join().assert_no_discovery("ordered")
+
+
+def test_orl_messages_eventually_delivered():
+    checker = _orl_model().checker().spawn_bfs().join()
+    checker.assert_discovery(
+        "delivered",
+        [
+            Deliver(src=Id(0), dst=Id(1), msg=("deliver", 1, 42)),
+            Deliver(src=Id(0), dst=Id(1), msg=("deliver", 2, 43)),
+        ],
+    )
