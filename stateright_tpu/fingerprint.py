@@ -80,7 +80,10 @@ def hash_words(words: Iterable[int], seed: int = FINGERPRINT_SEED) -> int:
         h = fold64(h, w)
         n += 1
     h = fold64(h, n)  # length-extension guard
-    if h == 0:
+    if h == 0 or h == MASK64:
+        # 0 is reserved as the "no parent / no discovery" marker and 2^64-1 as
+        # the device hash-table empty-slot sentinel; remap both (same accepted
+        # collision class as 64-bit fp collisions generally).
         h = _SM_GAMMA
     return h
 

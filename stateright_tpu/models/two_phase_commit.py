@@ -7,9 +7,10 @@ seen prepared, and a monotonic message set.  Properties: commit/abort
 agreement is reachable (`sometimes`) and no RM ever aborts while another
 commits (`always consistent`).
 
-This is also the framework's flagship tensor-form model: see
-``parallel/models/two_phase_commit.py`` for the u64-row encoding checked by
-the TPU wavefront engine; both forms agree on fingerprints.
+This is also the framework's flagship tensor-form model: :class:`TwoPhaseTensor`
+below is the u64-row encoding checked by the TPU wavefront engine; both forms
+agree on fingerprints bit-for-bit (``TwoPhaseSys`` is tensor-backed, so even
+the CPU checkers fingerprint via the row encoding).
 
 Pinned counts (reference ``examples/2pc.rs:125-140``): 288 @ 3 RMs,
 8,832 @ 5 RMs, 665 @ 5 RMs with symmetry reduction.
@@ -21,6 +22,7 @@ from dataclasses import dataclass, replace
 from typing import Optional
 
 from .. import Model, Property
+from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
 from ..symmetry import RewritePlan
 from ._cli import default_threads, run_cli
 
@@ -59,11 +61,14 @@ class TwoPhaseState:
 
 
 @dataclass
-class TwoPhaseSys(Model):
+class TwoPhaseSys(TensorBackedModel, Model):
     """Abstract 2PC over ``rm_count`` resource managers
     (reference ``2pc.rs:43-121``)."""
 
     rm_count: int
+
+    def tensor_model(self) -> "TwoPhaseTensor":
+        return TwoPhaseTensor(self)
 
     def init_states(self):
         n = self.rm_count
@@ -145,6 +150,175 @@ class TwoPhaseSys(Model):
                 ),
             ),
         ]
+
+
+# ---------------------------------------------------------------------------
+# Tensor form (device twin)
+# ---------------------------------------------------------------------------
+
+# Numeric RM-state codes for the row encoding.
+_RM_CODE = {WORKING: 0, PREPARED: 1, COMMITTED: 2, ABORTED: 3}
+_RM_NAME = {v: k for k, v in _RM_CODE.items()}
+_TM_CODE = {TM_INIT: 0, TM_COMMITTED: 1, TM_ABORTED: 2}
+_TM_NAME = {v: k for k, v in _TM_CODE.items()}
+
+
+class TwoPhaseTensor(TensorModel):
+    """u64-row encoding of :class:`TwoPhaseState` with a static-arity jittable
+    transition (the SURVEY §7 "minimum end-to-end slice" model).
+
+    Layout (word-aligned by :class:`BitPacker`): ``rm`` packs 2 bits per RM;
+    ``tm`` 2 bits; ``tm_prepared`` / ``msg_prepared`` one bit per RM;
+    ``msg_commit`` / ``msg_abort`` one bit each.  The monotone message *set*
+    of the object form (reference ``2pc.rs:16-21``) becomes a bitmask, which
+    is automatically canonical — equal sets encode to equal words.
+
+    Static action arity A = 2 + 5·rm_count, slots ordered:
+    ``tm_commit, tm_abort,`` then per RM ``tm_rcv_prepared, rm_prepare,
+    rm_choose_abort, rm_rcv_commit, rm_rcv_abort``.
+    """
+
+    def __init__(self, sys: TwoPhaseSys):
+        n = sys.rm_count
+        if n > 29:
+            raise ValueError("tensor 2PC supports up to 29 RMs per word")
+        self.model = sys
+        self.n = n
+        self.packer = BitPacker(
+            [
+                ("rm", 2 * n),
+                ("tm", 2),
+                ("tm_prepared", n),
+                ("msg_prepared", n),
+                ("msg_commit", 1),
+                ("msg_abort", 1),
+            ]
+        )
+        self.width = self.packer.width
+        self.max_actions = 2 + 5 * n
+
+    # -- host bridge ---------------------------------------------------------
+
+    def encode_state(self, s: TwoPhaseState) -> tuple:
+        rm = 0
+        for i, st in enumerate(s.rm_state):
+            rm |= _RM_CODE[st] << (2 * i)
+        prep = sum(1 << i for i, p in enumerate(s.tm_prepared) if p)
+        mprep = sum(1 << m[1] for m in s.msgs if m[0] == "prepared")
+        return self.packer.pack(
+            rm=rm,
+            tm=_TM_CODE[s.tm_state],
+            tm_prepared=prep,
+            msg_prepared=mprep,
+            msg_commit=int(("commit",) in s.msgs),
+            msg_abort=int(("abort",) in s.msgs),
+        )
+
+    def decode_state(self, row) -> TwoPhaseState:
+        f = self.packer.unpack(row)
+        n = self.n
+        msgs = set()
+        for i in range(n):
+            if (f["msg_prepared"] >> i) & 1:
+                msgs.add(("prepared", i))
+        if f["msg_commit"]:
+            msgs.add(("commit",))
+        if f["msg_abort"]:
+            msgs.add(("abort",))
+        return TwoPhaseState(
+            rm_state=tuple(_RM_NAME[(f["rm"] >> (2 * i)) & 3] for i in range(n)),
+            tm_state=_TM_NAME[f["tm"]],
+            tm_prepared=tuple(bool((f["tm_prepared"] >> i) & 1) for i in range(n)),
+            msgs=frozenset(msgs),
+        )
+
+    def init_rows(self):
+        import numpy as np
+
+        rows = [self.encode_state(s) for s in self.model.init_states()]
+        return np.asarray(rows, dtype=np.uint64)
+
+    # -- device --------------------------------------------------------------
+
+    def step_rows(self, rows):
+        import jax.numpy as jnp
+
+        pk, n = self.packer, self.n
+        one = jnp.uint64(1)
+        rm = pk.get(rows, "rm")
+        tm = pk.get(rows, "tm")
+        prep = pk.get(rows, "tm_prepared")
+        mprep = pk.get(rows, "msg_prepared")
+        mc = pk.get(rows, "msg_commit")
+        ma = pk.get(rows, "msg_abort")
+
+        tm_init = tm == jnp.uint64(0)
+        all_prepared = prep == jnp.uint64((1 << n) - 1)
+
+        succs, valids = [], []
+
+        def emit(valid, new_rows):
+            valids.append(valid)
+            succs.append(new_rows)
+
+        # tm_commit / tm_abort
+        r = pk.set(rows, "tm", jnp.uint64(1))
+        r = pk.set(r, "msg_commit", jnp.ones_like(mc))
+        emit(tm_init & all_prepared, r)
+        r = pk.set(rows, "tm", jnp.uint64(2))
+        r = pk.set(r, "msg_abort", jnp.ones_like(ma))
+        emit(tm_init, r)
+
+        for i in range(n):
+            bit = jnp.uint64(1 << i)
+            rm_i = (rm >> jnp.uint64(2 * i)) & jnp.uint64(3)
+            rm_clear = rm & jnp.uint64(~(3 << (2 * i)) & ((1 << (2 * n)) - 1))
+
+            # tm_rcv_prepared(i)
+            emit(
+                tm_init & ((mprep >> jnp.uint64(i)) & one == one),
+                pk.set(rows, "tm_prepared", prep | bit),
+            )
+            # rm_prepare(i): rm working -> prepared + send prepared msg
+            r = pk.set(rows, "rm", rm_clear | (jnp.uint64(1) << jnp.uint64(2 * i)))
+            r = pk.set(r, "msg_prepared", mprep | bit)
+            emit(rm_i == jnp.uint64(0), r)
+            # rm_choose_abort(i)
+            emit(
+                rm_i == jnp.uint64(0),
+                pk.set(rows, "rm", rm_clear | (jnp.uint64(3) << jnp.uint64(2 * i))),
+            )
+            # rm_rcv_commit(i)
+            emit(
+                mc == one,
+                pk.set(rows, "rm", rm_clear | (jnp.uint64(2) << jnp.uint64(2 * i))),
+            )
+            # rm_rcv_abort(i)
+            emit(
+                ma == one,
+                pk.set(rows, "rm", rm_clear | (jnp.uint64(3) << jnp.uint64(2 * i))),
+            )
+
+        succ = jnp.stack(succs, axis=-2)  # [B, A, W]
+        valid = jnp.stack(valids, axis=-1)  # [B, A]
+        return succ, valid
+
+    def property_masks(self, rows):
+        import jax.numpy as jnp
+
+        pk, n = self.packer, self.n
+        rm = pk.get(rows, "rm")
+        all_aborted = rm == jnp.uint64((1 << (2 * n)) - 1)  # 0b11 per RM
+        all_committed = rm == jnp.uint64(int("10" * n, 2))  # 0b10 per RM
+        any_committed = jnp.zeros(rows.shape[:-1], bool)
+        any_aborted = jnp.zeros(rows.shape[:-1], bool)
+        for i in range(n):
+            rm_i = (rm >> jnp.uint64(2 * i)) & jnp.uint64(3)
+            any_committed |= rm_i == jnp.uint64(2)
+            any_aborted |= rm_i == jnp.uint64(3)
+        consistent = ~(any_committed & any_aborted)
+        # order matches TwoPhaseSys.properties()
+        return jnp.stack([all_aborted, all_committed, consistent], axis=-1)
 
 
 def main(argv=None):

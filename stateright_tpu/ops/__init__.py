@@ -1,0 +1,14 @@
+"""Device kernels: vectorized fingerprinting, hash-table dedup, sorting ops.
+
+Everything in this package runs under ``jit`` on TPU (or the CPU backend in
+tests).  64-bit integers are required for fingerprint math, so importing this
+package enables JAX x64 mode; all kernels use explicit dtypes, so the change
+to *default* dtypes does not leak into user code paths.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .hashing import EMPTY, row_hash  # noqa: E402,F401
+from .hashtable import hash_insert  # noqa: E402,F401

@@ -1,0 +1,58 @@
+"""Vectorized state fingerprinting on device.
+
+Implements exactly :func:`stateright_tpu.fingerprint.hash_words` over
+fixed-width ``uint64`` row encodings, so device fingerprints match host
+fingerprints bit-for-bit.  That identity is what lets the TPU engine store
+only ``fp -> parent fp`` while the host reconstructs full traces by
+re-executing the object-form model (reference analogue: build-stable hashing,
+``src/lib.rs:331-344``).
+
+TPU note: the VPU has 32-bit lanes; XLA emulates u64 arithmetic as u32 pairs.
+The splitmix64 round is 2 multiplies + 3 shift-xors per word — cheap relative
+to the transition expansion, and entirely fusible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..fingerprint import FINGERPRINT_SEED
+
+_GAMMA = jnp.uint64(0x9E3779B97F4A7C15)
+_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_M2 = jnp.uint64(0x94D049BB133111EB)
+_SEED = jnp.uint64(FINGERPRINT_SEED)
+
+# Empty-slot sentinel for device hash tables.  Fingerprints are accepted to
+# collide at the 64-bit level (as in the reference); colliding with the
+# sentinel is the same class of risk.
+EMPTY = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def mix64(h: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer, elementwise over a uint64 array."""
+    h = h ^ (h >> jnp.uint64(30))
+    h = h * _M1
+    h = h ^ (h >> jnp.uint64(27))
+    h = h * _M2
+    h = h ^ (h >> jnp.uint64(31))
+    return h
+
+
+def fold64(h: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Fold one word into the running digest (= host ``fingerprint.fold64``)."""
+    return mix64((h ^ w) + _GAMMA)
+
+
+def row_hash(rows: jnp.ndarray) -> jnp.ndarray:
+    """Fingerprint each row: ``uint64[..., W] -> uint64[...]``.
+
+    Identical to ``hash_words(row)`` on host: fold each of the W words, fold
+    the length, remap 0 to a nonzero constant.
+    """
+    width = rows.shape[-1]
+    h = jnp.full(rows.shape[:-1], _SEED, jnp.uint64)
+    for i in range(width):
+        h = fold64(h, rows[..., i])
+    h = fold64(h, jnp.uint64(width))
+    return jnp.where((h == jnp.uint64(0)) | (h == EMPTY), _GAMMA, h)

@@ -1,0 +1,94 @@
+"""Device-resident visited-set: open-addressing hash table with parallel insert.
+
+The reference's shared visited set is a lock-striped concurrent map
+(``DashMap`` — reference ``src/checker/bfs.rs:26``).  The TPU equivalent is an
+HBM-resident table of fingerprints (+ aligned parent-pointer payload) updated
+by a data-parallel claim protocol built from XLA scatter-min:
+
+ 1. every live candidate gathers its current slot;
+ 2. slot holds my fp            -> duplicate, retire;
+ 3. slot empty                  -> claim it via ``scatter-min`` (EMPTY is the
+    max u64, so the smallest claiming fp wins deterministically);
+ 4. re-gather: if the slot now holds my fp I won (novel), else linear-probe
+    to the next slot and repeat.
+
+Correctness relies on (a) candidates being pre-deduplicated (two equal fps
+would both "win" the same claim), and (b) slots never being emptied, which
+preserves the linear-probe search invariant.  The claim loop is a
+``lax.while_loop``, so the whole insert stays on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .hashing import EMPTY
+
+
+def hash_insert(
+    table_fp: jnp.ndarray,  # uint64[cap], EMPTY = free; cap is a power of two
+    table_payload: jnp.ndarray,  # uint64[cap], payload per slot (parent fp)
+    fps: jnp.ndarray,  # uint64[M] candidate fingerprints, pre-deduplicated
+    payloads: jnp.ndarray,  # uint64[M]
+    valid: jnp.ndarray,  # bool[M]
+    max_probes: int | None = None,
+):
+    """Insert candidates; returns ``(table_fp, table_payload, novel, overflow)``.
+
+    ``novel[i]`` is True iff candidate ``i`` was valid and not already present.
+    ``overflow`` is True if probing was exhausted (table effectively full) —
+    the caller restarts with a larger capacity.
+    """
+    cap = table_fp.shape[0]
+    assert cap & (cap - 1) == 0, "table capacity must be a power of two"
+    mask = jnp.uint64(cap - 1)
+    if max_probes is None:
+        max_probes = cap
+
+    pos0 = (fps & mask).astype(jnp.int32)
+    novel0 = jnp.zeros(fps.shape, bool)
+
+    def cond(carry):
+        _, _, _, alive, _, probes = carry
+        return jnp.logical_and(jnp.any(alive), probes < max_probes)
+
+    def body(carry):
+        tfp, tpl, pos, alive, novel, probes = carry
+        cur = tfp[pos]
+        is_dup = alive & (cur == fps)
+        is_empty = alive & (cur == EMPTY)
+        # Claim attempt: scatter-min of my fp into my slot (no-op unless the
+        # slot is EMPTY from my point of view; different claimants of the same
+        # slot resolve by min-fp).
+        claim = jnp.where(is_empty, fps, EMPTY)
+        tfp = tfp.at[pos].min(claim)
+        won = is_empty & (tfp[pos] == fps)
+        # Only winners write their payload; losers scatter out of range.
+        tpl = tpl.at[jnp.where(won, pos, cap)].set(payloads, mode="drop")
+        novel = novel | won
+        alive = alive & ~is_dup & ~won
+        pos = jnp.where(alive, (pos + 1) & (cap - 1), pos)
+        return tfp, tpl, pos, alive, novel, probes + 1
+
+    table_fp, table_payload, _, alive, novel, _ = jax.lax.while_loop(
+        cond, body, (table_fp, table_payload, pos0, valid, novel0, jnp.int32(0))
+    )
+    return table_fp, table_payload, novel, jnp.any(alive)
+
+
+def dedupe_sorted(fps: jnp.ndarray):
+    """Sort candidate fps and mask first occurrences.
+
+    Returns ``(order, first)`` where ``order`` is the stable sort permutation
+    and ``first[i]`` marks the first occurrence of ``fps[order[i]]`` (False
+    for EMPTY sentinels, which sort to the end).  Gathering payload arrays by
+    ``order`` aligns them with ``first``.
+    """
+    order = jnp.argsort(fps, stable=True)
+    sorted_fp = fps[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_fp[1:] != sorted_fp[:-1]]
+    )
+    first = first & (sorted_fp != EMPTY)
+    return order, first

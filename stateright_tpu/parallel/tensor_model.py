@@ -1,0 +1,159 @@
+"""Tensor form of a model: fixed-width u64 rows + jittable batched transition.
+
+A :class:`TensorModel` is the device twin of an object-form
+:class:`~stateright_tpu.core.Model` (reference trait: ``src/lib.rs:155-237``).
+Where the reference enumerates actions dynamically per state
+(``src/actor/model.rs:214-239``), the tensor form declares a *static maximum
+action arity* ``max_actions`` and returns a validity mask — the shape XLA
+needs to tile the expansion onto the MXU/VPU without dynamic shapes.
+
+Contract (``B`` = batch, ``W`` = width, ``A`` = max_actions, ``P`` = number of
+properties, in the object model's ``properties()`` order):
+
+ - ``init_rows() -> uint64[I, W]``  (host-side numpy is fine)
+ - ``step_rows(rows: uint64[B, W]) -> (uint64[B, A, W], bool[B, A])``
+   pure + jittable.  ``valid[b, a]`` ⟺ action ``a`` is enabled in row ``b``,
+   produces a real successor (not a no-op — reference prunes those,
+   ``src/actor/model.rs:253-260``), and the successor is within the boundary.
+   Invalid successor rows may contain garbage.
+ - ``property_masks(rows: uint64[B, W]) -> bool[B, P]`` — condition truth
+   per state per property; pure + jittable.
+ - ``encode_state(state) -> tuple[int, ...]`` / ``decode_state(row) -> state``
+   host-side bridge to the object form.  ``fingerprint(encode_state(s))`` via
+   :func:`~stateright_tpu.fingerprint.hash_words` must equal the device
+   ``row_hash`` of the same row — guaranteed by construction since both hash
+   the same W words.
+
+Equivalence between the two forms (same successors, same fingerprints) is a
+test obligation; see ``tests/test_tensor_models.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..fingerprint import hash_words
+
+
+class TensorModel:
+    """Base class for device twins of object-form models."""
+
+    width: int  # u64 words per state row
+    max_actions: int  # static action arity A
+    model: Any  # the object-form Model (properties, display, re-execution)
+
+    # -- host-side bridge ----------------------------------------------------
+
+    def init_rows(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def encode_state(self, state) -> tuple:
+        raise NotImplementedError
+
+    def decode_state(self, row) -> Any:
+        raise NotImplementedError
+
+    def fingerprint_of(self, state) -> int:
+        """Host fingerprint that matches the device ``row_hash`` bit-for-bit."""
+        return hash_words(self.encode_state(state))
+
+    # -- device-side ---------------------------------------------------------
+
+    def step_rows(self, rows):
+        raise NotImplementedError
+
+    def property_masks(self, rows):
+        raise NotImplementedError
+
+
+class TensorBackedModel:
+    """Mixin for object-form models that have a tensor twin.
+
+    Overrides ``fingerprint_state`` to the row hash so every backend (CPU
+    BFS/DFS, TPU wavefront, Explorer URLs) agrees on state identity, the way
+    the reference's single stable hash does (``src/lib.rs:302-344``).
+    """
+
+    def tensor_model(self) -> Optional[TensorModel]:
+        raise NotImplementedError
+
+    def fingerprint_state(self, state) -> int:
+        tm = self._tensor_cached()
+        return hash_words(tm.encode_state(state))
+
+    def _tensor_cached(self) -> TensorModel:
+        tm = getattr(self, "_tensor_model_cache", None)
+        if tm is None:
+            tm = self.tensor_model()
+            object.__setattr__(self, "_tensor_model_cache", tm)
+        return tm
+
+
+class BitPacker:
+    """Packs named bit fields into u64 words; fields never straddle words.
+
+    Host side packs/unpacks Python ints (no jax import); device side extracts
+    and rebuilds fields with shifts and masks on ``uint64`` arrays.  Word
+    alignment costs a few wasted bits but keeps device field access to a
+    single shift+mask.
+    """
+
+    def __init__(self, fields: Sequence[tuple[str, int]]):
+        self.fields = list(fields)
+        self.layout: dict[str, tuple[int, int, int]] = {}  # name -> (word, off, bits)
+        word, off = 0, 0
+        for name, bits in self.fields:
+            if not 1 <= bits <= 64:
+                raise ValueError(f"field {name!r}: bits must be in 1..64")
+            if off + bits > 64:
+                word, off = word + 1, 0
+            self.layout[name] = (word, off, bits)
+            off += bits
+        self.width = word + 1
+
+    # -- host ----------------------------------------------------------------
+
+    def pack(self, **values: int) -> tuple:
+        words = [0] * self.width
+        for name, (word, off, bits) in self.layout.items():
+            v = values.pop(name, 0)
+            if not 0 <= v < (1 << bits):
+                raise ValueError(f"field {name!r}={v} out of range ({bits} bits)")
+            words[word] |= v << off
+        if values:
+            raise ValueError(f"unknown fields: {sorted(values)}")
+        return tuple(words)
+
+    def unpack(self, row) -> dict[str, int]:
+        return {
+            name: (int(row[word]) >> off) & ((1 << bits) - 1)
+            for name, (word, off, bits) in self.layout.items()
+        }
+
+    # -- device --------------------------------------------------------------
+
+    def get(self, rows, name: str):
+        """Extract field ``name``: ``uint64[..., W] -> uint64[...]``."""
+        import jax.numpy as jnp
+
+        word, off, bits = self.layout[name]
+        v = rows[..., word]
+        if off:
+            v = v >> jnp.uint64(off)
+        if bits < 64:
+            v = v & jnp.uint64((1 << bits) - 1)
+        return v
+
+    def set(self, rows, name: str, value):
+        """Return rows with field ``name`` replaced by ``value`` (uint64[...])."""
+        import jax.numpy as jnp
+
+        word, off, bits = self.layout[name]
+        mask = jnp.uint64(((1 << bits) - 1) << off)
+        cleared = rows[..., word] & ~mask
+        v = value.astype(jnp.uint64) if hasattr(value, "astype") else jnp.uint64(value)
+        if off:
+            v = v << jnp.uint64(off)
+        return rows.at[..., word].set(cleared | (v & mask))

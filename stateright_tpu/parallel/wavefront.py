@@ -1,0 +1,370 @@
+"""The TPU wavefront BFS engine — ``spawn_tpu()``.
+
+Replaces the reference's work-stealing threaded BFS (``src/checker/bfs.rs``)
+with frontier data-parallelism: each BFS level is a device array of encoded
+states; per wavefront the engine, entirely inside one jitted
+``lax.while_loop`` (zero host round-trips until the run finishes):
+
+ 1. evaluates all property conditions as fused boolean kernels over the
+    frontier (reference analogue ``bfs.rs:192-227``), recording first-hit
+    fingerprints per property (first-writer-wins, like the reference's benign
+    discovery races ``bfs.rs:197-207``, but deterministic here);
+ 2. expands every state through the tensor model's static-arity transition
+    (``step_rows``), masking disabled/no-op actions;
+ 3. flushes pending ``eventually`` bits at terminal states as liveness
+    counterexamples (``bfs.rs:265-272``; the reference's documented DAG-join /
+    cycle caveats are replicated since ebits are not fingerprinted);
+ 4. fingerprints all successors, dedupes them (sort + first-occurrence mask),
+    and inserts into the HBM hash table (``ops/hashtable.py``), which stores
+    the parent fingerprint per slot — the device analogue of the reference's
+    ``DashMap<Fingerprint, Option<Fingerprint>>`` (``bfs.rs:26``);
+ 5. compacts the novel survivors into the next frontier.
+
+Trace reconstruction is host-side and identical in spirit to the reference
+(``bfs.rs:314-342``): walk parent fingerprints back to an init state, then
+re-execute the *object-form* model (``Path.from_fingerprints``), which works
+because host and device fingerprint functions agree bit-for-bit.
+
+Capacities (hash-table slots / frontier rows) are static shapes; on overflow
+the engine restarts with doubled capacity (geometric, so wasted work is
+bounded by a constant factor).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..checker.base import Checker, CheckerBuilder
+from ..checker.path import Path
+from ..core import Expectation
+from ..fingerprint import MASK64
+from ..ops.hashing import EMPTY, row_hash
+from ..ops.hashtable import dedupe_sorted, hash_insert
+
+_STATUS_OK = 0
+_STATUS_FRONTIER_OVERFLOW = 1
+_STATUS_TABLE_OVERFLOW = 2
+
+
+def _build_run(tensor, props, cap: int, fcap: int, target: Optional[int]):
+    """Build the jitted whole-run function for fixed capacities."""
+    width, arity = tensor.width, tensor.max_actions
+    n_props = len(props)
+    ev_idx = [
+        i for i, p in enumerate(props) if p.expectation is Expectation.EVENTUALLY
+    ]
+    ebit_of = {i: e for e, i in enumerate(ev_idx)}
+    if len(ev_idx) > 32:
+        raise ValueError("at most 32 eventually properties are supported")
+    init_ebits = jnp.uint32((1 << len(ev_idx)) - 1)
+
+    init_rows_np = np.asarray(tensor.init_rows(), dtype=np.uint64)
+    n_init = init_rows_np.shape[0]
+
+    def record_first(disc, i, hit, fps):
+        """First-wins discovery of property ``i`` at the first hit row."""
+        fp = fps[jnp.argmax(hit)]
+        take = (disc[i] == jnp.uint64(0)) & jnp.any(hit)
+        return disc.at[i].set(jnp.where(take, fp, disc[i]))
+
+    def eval_props(rows, fps, live, ebits, disc):
+        masks = tensor.property_masks(rows)  # [F, P] bool
+        for i, p in enumerate(props):
+            if p.expectation is Expectation.ALWAYS:
+                disc = record_first(disc, i, live & ~masks[..., i], fps)
+            elif p.expectation is Expectation.SOMETIMES:
+                disc = record_first(disc, i, live & masks[..., i], fps)
+            else:
+                clear = jnp.uint32(~(1 << ebit_of[i]) & 0xFFFFFFFF)
+                ebits = jnp.where(masks[..., i], ebits & clear, ebits)
+        return ebits, disc
+
+    def flush_terminal(terminal, fps, ebits, disc):
+        for i in ev_idx:
+            bit = (ebits >> jnp.uint32(ebit_of[i])) & jnp.uint32(1)
+            disc = record_first(disc, i, terminal & (bit == jnp.uint32(1)), fps)
+        return disc
+
+    def all_discovered(disc):
+        if n_props == 0:
+            return jnp.bool_(False)
+        return jnp.all(disc != jnp.uint64(0))
+
+    def insert_and_compact(tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits):
+        """Dedup candidates, claim table slots, compact novel rows into a
+        frontier-shaped buffer.  Returns updated tables + next frontier."""
+        m = cand_fp.shape[0]
+        order, first = dedupe_sorted(cand_fp)
+        sfp = cand_fp[order]
+        srows = cand_rows[order]
+        spar = cand_par[order]
+        sebt = cand_ebits[order]
+        tfp, tpl, novel, overflow = hash_insert(tfp, tpl, sfp, spar, first)
+        n_new = jnp.sum(novel)
+        keys = jnp.where(novel, jnp.arange(m, dtype=jnp.int32), jnp.int32(m))
+        perm = jnp.argsort(keys)[:fcap]
+        return (
+            tfp,
+            tpl,
+            srows[perm],
+            sfp[perm],
+            sebt[perm],
+            n_new.astype(jnp.int32),
+            overflow,
+        )
+
+    def expand(carry):
+        (tfp, tpl, rows, fps, ebits, fcount, unique, scount, disc, depth, status) = carry
+        live = jnp.arange(fcap) < fcount
+        succ, valid = tensor.step_rows(rows)  # [F, A, W], [F, A]
+        valid = valid & live[:, None]
+        scount = scount + jnp.sum(valid, dtype=jnp.int64)
+        terminal = live & ~jnp.any(valid, axis=-1)
+        disc = flush_terminal(terminal, fps, ebits, disc)
+
+        cand_fp = jnp.where(valid, row_hash(succ), EMPTY).reshape(fcap * arity)
+        cand_rows = succ.reshape(fcap * arity, width)
+        cand_par = jnp.broadcast_to(fps[:, None], (fcap, arity)).reshape(-1)
+        cand_ebits = jnp.broadcast_to(ebits[:, None], (fcap, arity)).reshape(-1)
+
+        tfp, tpl, nrows, nfps, nebits, n_new, toverflow = insert_and_compact(
+            tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits
+        )
+        unique = unique + n_new.astype(jnp.int64)
+        # n_new is clamped to what survived compaction only if it fits
+        foverflow = n_new > fcap
+        status = jnp.where(
+            toverflow,
+            jnp.int32(_STATUS_TABLE_OVERFLOW),
+            jnp.where(foverflow, jnp.int32(_STATUS_FRONTIER_OVERFLOW), status),
+        )
+        depth = depth + jnp.where(n_new > 0, 1, 0).astype(jnp.int32)
+        return (tfp, tpl, nrows, nfps, nebits, n_new, unique, scount, disc, depth, status)
+
+    def body(carry):
+        (tfp, tpl, rows, fps, ebits, fcount, unique, scount, disc, depth, status) = carry
+        live = jnp.arange(fcap) < fcount
+        ebits, disc = eval_props(rows, fps, live, ebits, disc)
+        carry = (tfp, tpl, rows, fps, ebits, fcount, unique, scount, disc, depth, status)
+        # Stop immediately once every property has a discovery, as the
+        # reference does mid-block (``bfs.rs:121-128``): skip the expansion.
+        return jax.lax.cond(
+            all_discovered(disc),
+            lambda c: c[:5] + (jnp.int32(0),) + c[6:],
+            expand,
+            carry,
+        )
+
+    def cond(carry):
+        (_, _, _, _, _, fcount, unique, _, disc, _, status) = carry
+        go = (status == jnp.int32(_STATUS_OK)) & (fcount > 0)
+        go = go & ~all_discovered(disc)
+        if target is not None:
+            go = go & (unique < jnp.int64(target))
+        return go
+
+    @partial(jax.jit)
+    def run():
+        tfp = jnp.full((cap,), EMPTY, jnp.uint64)
+        tpl = jnp.zeros((cap,), jnp.uint64)
+        irows = jnp.asarray(init_rows_np)
+        ifp = row_hash(irows)
+        # pad candidates to at least frontier shape handling
+        cand_rows = irows
+        cand_fp = ifp
+        cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = "is an init state"
+        cand_ebits = jnp.full((n_init,), init_ebits, jnp.uint32)
+        tfp, tpl, rows, fps, ebits, fcount, overflow = insert_and_compact(
+            tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits
+        )
+        # pad frontier buffers from n_init up to fcap
+        pad = fcap - rows.shape[0]
+        if pad > 0:
+            rows = jnp.concatenate([rows, jnp.zeros((pad, width), jnp.uint64)])
+            fps = jnp.concatenate([fps, jnp.full((pad,), EMPTY, jnp.uint64)])
+            ebits = jnp.concatenate([ebits, jnp.zeros((pad,), jnp.uint32)])
+        else:
+            rows, fps, ebits = rows[:fcap], fps[:fcap], ebits[:fcap]
+        status = jnp.where(
+            overflow, jnp.int32(_STATUS_TABLE_OVERFLOW), jnp.int32(_STATUS_OK)
+        )
+        carry = (
+            tfp,
+            tpl,
+            rows,
+            fps,
+            ebits,
+            fcount,
+            fcount.astype(jnp.int64),  # unique
+            jnp.int64(n_init),  # state_count counts all inits (bfs parity)
+            jnp.zeros((max(n_props, 1),), jnp.uint64),  # disc (min size 1)
+            jnp.int32(0),  # depth
+            status,
+        )
+        carry = jax.lax.while_loop(cond, body, carry)
+        (tfp, tpl, _, _, _, _, unique, scount, disc, depth, status) = carry
+        return tfp, tpl, unique, scount, disc, depth, status
+
+    return run
+
+
+class TpuChecker(Checker):
+    """Wavefront BFS on the default JAX device (TPU on hardware, CPU in tests).
+
+    Requires the model to provide a tensor twin via ``model.tensor_model()``
+    and to fingerprint states via the row encoding (``TensorBackedModel``),
+    so host-side path reconstruction matches device fingerprints.
+    """
+
+    def __init__(
+        self,
+        options: CheckerBuilder,
+        capacity: int = 1 << 17,
+        frontier_capacity: int = 1 << 12,
+        sync: bool = False,
+    ):
+        self.model = options.model
+        tensor = getattr(self.model, "tensor_model", lambda: None)()
+        if tensor is None:
+            raise TypeError(
+                f"{type(self.model).__name__} has no tensor form: implement "
+                "tensor_model() (see parallel/tensor_model.py) or use "
+                "spawn_bfs()/spawn_dfs()"
+            )
+        if options.symmetry_fn is not None:
+            raise NotImplementedError(
+                "symmetry reduction on the TPU engine is not supported yet; "
+                "use spawn_dfs()"
+            )
+        if options.visitor_obj is not None:
+            raise NotImplementedError(
+                "per-state visitors require host materialization; use "
+                "spawn_bfs() (the TPU engine never materializes states)"
+            )
+        self.tensor = tensor
+        self._props = list(self.model.properties())
+        self._target = options.target_state_count
+        self._cap = capacity
+        self._fcap = frontier_capacity
+        self._verify_fingerprint_bridge()
+
+        self._results = None
+        self._parent_map: Optional[dict[int, int]] = None
+        self._done = threading.Event()
+        self._thread = None
+        if sync:
+            self._run()
+        else:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _verify_fingerprint_bridge(self):
+        """Host fingerprint must equal the device row hash, else traces cannot
+        be reconstructed (the tensor analogue of the reference's
+        nondeterminism diagnostics, ``path.rs:35-49``)."""
+        for s in self.model.init_states():
+            host_fp = self.model.fingerprint_state(s)
+            row = np.asarray([self.tensor.encode_state(s)], dtype=np.uint64)
+            dev_fp = int(np.asarray(row_hash(jnp.asarray(row)))[0])
+            if host_fp != dev_fp:
+                raise RuntimeError(
+                    "model.fingerprint_state disagrees with the device row "
+                    "hash; tensor-backed models must fingerprint via their "
+                    "row encoding (mix in TensorBackedModel)"
+                )
+            break
+
+    # -- run loop ------------------------------------------------------------
+
+    def _run(self):
+        cap, fcap = self._cap, self._fcap
+        # Compiled-run cache lives on the tensor model so repeated checks of
+        # the same system (warmup + timed bench runs) compile once.
+        cache = getattr(self.tensor, "_run_cache", None)
+        if cache is None:
+            cache = {}
+            self.tensor._run_cache = cache
+        while True:
+            key = (cap, fcap, self._target)
+            run = cache.get(key)
+            if run is None:
+                run = _build_run(self.tensor, self._props, cap, fcap, self._target)
+                cache[key] = run
+            tfp, tpl, unique, scount, disc, depth, status = run()
+            status = int(status)
+            if status == _STATUS_TABLE_OVERFLOW:
+                cap *= 2
+                continue
+            if status == _STATUS_FRONTIER_OVERFLOW:
+                fcap *= 2
+                continue
+            break
+        self._cap, self._fcap = cap, fcap
+        self._results = {
+            "unique": int(unique),
+            "states": int(scount),
+            "disc": np.asarray(disc),
+            "depth": int(depth),
+            "table_fp": tfp,
+            "table_parent": tpl,
+        }
+        self._done.set()
+
+    # -- Checker surface -----------------------------------------------------
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    def join(self) -> "TpuChecker":
+        if self._thread is not None:
+            self._thread.join()
+        return self
+
+    def state_count(self) -> int:
+        return self._results["states"] if self._results else 0
+
+    def unique_state_count(self) -> int:
+        return self._results["unique"] if self._results else 0
+
+    def max_depth(self) -> int:
+        return self._results["depth"] if self._results else 0
+
+    def _parents(self) -> dict[int, int]:
+        if self._parent_map is None:
+            tfp = np.asarray(self._results["table_fp"])
+            tpl = np.asarray(self._results["table_parent"])
+            occupied = tfp != np.uint64(MASK64)
+            self._parent_map = dict(
+                zip(tfp[occupied].tolist(), tpl[occupied].tolist())
+            )
+        return self._parent_map
+
+    def _trace(self, fp: int) -> list[int]:
+        parents = self._parents()
+        fps = [fp]
+        while True:
+            parent = parents.get(fps[-1], 0)
+            if parent == 0:
+                break
+            fps.append(parent)
+        fps.reverse()
+        return fps
+
+    def discoveries(self) -> dict[str, Path]:
+        self.join()
+        disc = self._results["disc"]
+        out = {}
+        for i, prop in enumerate(self._props):
+            fp = int(disc[i])
+            if fp != 0:
+                out[prop.name] = Path.from_fingerprints(
+                    self.model, self._trace(fp)
+                )
+        return out

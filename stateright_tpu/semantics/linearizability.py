@@ -105,13 +105,16 @@ class LinearizabilityTester(ConsistencyTester):
     # -- checking (reference ``linearizability.rs:165-240``) -----------------
 
     def is_consistent(self) -> bool:
-        key = stable_hash(self)
-        cached = _VERDICT_CACHE.get(key)
+        # Keyed by the tester itself (eq folds in the concrete type, so
+        # subclass verdicts never mix): dict equality resolves 64-bit hash
+        # collisions exactly, unlike fingerprint dedup where collisions are an
+        # accepted tradeoff.
+        cached = _VERDICT_CACHE.get(self)
         if cached is None:
             if len(_VERDICT_CACHE) >= _VERDICT_CACHE_MAX:
                 _VERDICT_CACHE.clear()
             cached = self.serialized_history() is not None
-            _VERDICT_CACHE[key] = cached
+            _VERDICT_CACHE[self] = cached
         return cached
 
     def serialized_history(self) -> Optional[list]:

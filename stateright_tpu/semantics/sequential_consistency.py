@@ -10,13 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..fingerprint import stable_hash
-from .linearizability import (
-    LinearizabilityTester,
-    _serialize,
-    _VERDICT_CACHE,
-    _VERDICT_CACHE_MAX,
-)
+from .linearizability import LinearizabilityTester, _serialize
 
 
 class SequentialConsistencyTester(LinearizabilityTester):
@@ -33,14 +27,6 @@ class SequentialConsistencyTester(LinearizabilityTester):
             [], self.init_ref_obj, remaining, dict(self.in_flight_by_thread),
             real_time=False,
         )
-
-    def is_consistent(self) -> bool:
-        # separate cache namespace from the linearizability verdicts
-        key = stable_hash(("SC", stable_hash(self)))
-        cached = _VERDICT_CACHE.get(key)
-        if cached is None:
-            if len(_VERDICT_CACHE) >= _VERDICT_CACHE_MAX:
-                _VERDICT_CACHE.clear()
-            cached = self.serialized_history() is not None
-            _VERDICT_CACHE[key] = cached
-        return cached
+        # is_consistent is inherited: the verdict cache is keyed by the tester
+        # itself and eq folds in the concrete type, so SC and linearizability
+        # verdicts never mix.

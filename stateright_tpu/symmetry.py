@@ -26,7 +26,6 @@ import dataclasses
 from enum import Enum
 from typing import Any, Callable, Iterable, Optional, Sequence
 
-from .fingerprint import stable_hash
 
 
 class RewritePlan:
