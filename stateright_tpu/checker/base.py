@@ -77,13 +77,20 @@ class CheckerBuilder:
 
     def spawn_tpu(self, **kw) -> "Checker":
         """The point of this framework: wavefront BFS on TPU (no reference
-        counterpart; see ``stateright_tpu/parallel/wavefront.py``)."""
-        try:
-            from ..parallel.wavefront import TpuChecker
-        except ImportError as e:  # scaffolding guard until the module lands
-            raise NotImplementedError(
-                "the TPU wavefront engine is not available yet"
-            ) from e
+        counterpart; see ``stateright_tpu/parallel/wavefront.py``).
+
+        Pass ``devices=N`` (or ``mesh=...``) to shard the wavefront over a
+        device mesh with all-to-all fingerprint routing
+        (``stateright_tpu/parallel/sharded.py``)."""
+        devices = kw.pop("devices", None)
+        if devices is not None and devices != 1:
+            kw.setdefault("n_devices", devices)
+        if "n_devices" in kw or "mesh" in kw:
+            from ..parallel.sharded import ShardedTpuChecker
+
+            return ShardedTpuChecker(self, **kw)
+        from ..parallel.wavefront import TpuChecker
+
         return TpuChecker(self, **kw)
 
     def serve(self, addr: str = "localhost:3000"):

@@ -47,7 +47,9 @@ def hash_insert(
         max_probes = cap
 
     pos0 = (fps & mask).astype(jnp.int32)
-    novel0 = jnp.zeros(fps.shape, bool)
+    # Derived from ``valid`` (not a fresh constant) so its sharding/vma type
+    # matches the loop body's under shard_map.
+    novel0 = valid & jnp.zeros_like(valid)
 
     def cond(carry):
         _, _, _, alive, _, probes = carry

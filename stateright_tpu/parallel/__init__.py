@@ -16,5 +16,6 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from .tensor_model import BitPacker, TensorModel  # noqa: E402,F401
+from .tensor_model import BitPacker, TensorBackedModel, TensorModel  # noqa: E402,F401
 from .wavefront import TpuChecker  # noqa: E402,F401
+from .sharded import ShardedTpuChecker, default_mesh  # noqa: E402,F401

@@ -1,0 +1,131 @@
+"""Shared result surface + host-side plumbing for the wavefront engines.
+
+Both the single-device (``wavefront.py``) and mesh-sharded (``sharded.py``)
+engines produce the same artifacts — a fingerprint→parent table, discovery
+fingerprints, and counters — and reconstruct traces identically (reference
+analogue ``src/checker/bfs.rs:314-342``).  This base class holds everything
+that is engine-independent so semantics fixes land once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..checker.base import Checker, CheckerBuilder
+from ..checker.path import Path
+from ..fingerprint import MASK64
+from ..ops.hashing import row_hash
+
+
+class WavefrontChecker(Checker):
+    """Common host-side surface for device wavefront engines."""
+
+    def _init_common(self, options: CheckerBuilder, sync: bool):
+        self.model = options.model
+        tensor = getattr(self.model, "tensor_model", lambda: None)()
+        if tensor is None:
+            raise TypeError(
+                f"{type(self.model).__name__} has no tensor form: implement "
+                "tensor_model() (see parallel/tensor_model.py) or use "
+                "spawn_bfs()/spawn_dfs()"
+            )
+        if options.symmetry_fn is not None:
+            raise NotImplementedError(
+                "symmetry reduction on the TPU engine is not supported yet; "
+                "use spawn_dfs()"
+            )
+        if options.visitor_obj is not None:
+            raise NotImplementedError(
+                "per-state visitors require host materialization; use "
+                "spawn_bfs() (the TPU engine never materializes states)"
+            )
+        self.tensor = tensor
+        self._props = list(self.model.properties())
+        self._target = options.target_state_count
+        self._verify_fingerprint_bridge()
+
+        self._results = None
+        self._parent_map: Optional[dict[int, int]] = None
+        self._done = threading.Event()
+        self._thread = None
+        if sync:
+            self._run()
+        else:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _verify_fingerprint_bridge(self):
+        """Host fingerprint must equal the device row hash, else traces cannot
+        be reconstructed (the tensor analogue of the reference's
+        nondeterminism diagnostics, ``path.rs:35-49``)."""
+        for s in self.model.init_states():
+            host_fp = self.model.fingerprint_state(s)
+            row = np.asarray([self.tensor.encode_state(s)], dtype=np.uint64)
+            dev_fp = int(np.asarray(row_hash(jnp.asarray(row)))[0])
+            if host_fp != dev_fp:
+                raise RuntimeError(
+                    "model.fingerprint_state disagrees with the device row "
+                    "hash; tensor-backed models must fingerprint via their "
+                    "row encoding (mix in TensorBackedModel)"
+                )
+            break
+
+    def _run(self):  # engine-specific
+        raise NotImplementedError
+
+    # -- Checker surface -----------------------------------------------------
+
+    def is_done(self) -> bool:
+        return self._done.is_set()
+
+    def join(self) -> "WavefrontChecker":
+        if self._thread is not None:
+            self._thread.join()
+        return self
+
+    def state_count(self) -> int:
+        return self._results["states"] if self._results else 0
+
+    def unique_state_count(self) -> int:
+        return self._results["unique"] if self._results else 0
+
+    def max_depth(self) -> int:
+        return self._results["depth"] if self._results else 0
+
+    def _parents(self) -> dict[int, int]:
+        if self._parent_map is None:
+            tfp = np.asarray(self._results["table_fp"])
+            tpl = np.asarray(self._results["table_parent"])
+            occupied = tfp != np.uint64(MASK64)
+            self._parent_map = dict(
+                zip(tfp[occupied].tolist(), tpl[occupied].tolist())
+            )
+        return self._parent_map
+
+    def _trace(self, fp: int) -> list[int]:
+        parents = self._parents()
+        fps = [fp]
+        while True:
+            parent = parents.get(fps[-1], 0)
+            if parent == 0:  # 0 marks "is an init state"
+                break
+            fps.append(parent)
+        fps.reverse()
+        return fps
+
+    def discoveries(self) -> dict[str, Path]:
+        self.join()
+        disc = self._results["disc"]
+        out = {}
+        for i, prop in enumerate(self._props):
+            fp = int(disc[i])
+            if fp != 0:
+                out[prop.name] = Path.from_fingerprints(
+                    self.model, self._trace(fp)
+                )
+        return out

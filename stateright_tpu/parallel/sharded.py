@@ -1,0 +1,386 @@
+"""Multi-device sharded wavefront BFS — the ICI-mesh scale-out engine.
+
+Scales the single-device wavefront engine (``wavefront.py``) across a
+1-D ``jax.sharding.Mesh`` the way the reference scales across threads with a
+work-stealing job market (reference ``src/checker/bfs.rs:70-151``) — except
+that here "work distribution" is data-parallel sharding of the frontier and
+"the shared visited set" (reference ``bfs.rs:26``) is partitioned by
+fingerprint ownership:
+
+ - Every device holds one shard of the visited hash table.  A fingerprint's
+   owner is ``(fp >> 32) % D`` (high bits, so they stay independent of the
+   low bits that pick the probe slot inside the owner's table shard).
+ - Per wavefront, each device expands its local frontier slice, then routes
+   every candidate successor to its owner via ``lax.all_to_all`` over the
+   mesh axis — the ICI is the "job market".
+ - The owner dedupes + claims table slots locally (``ops/hashtable.py``) and
+   keeps its novel states as its slice of the next frontier, so the frontier
+   stays balanced by fingerprint uniformity rather than explicit stealing.
+ - Counters and termination are ``psum``/``pmax`` all-reduces (reference
+   analogue: the atomic ``state_count`` + "all threads waiting" test,
+   ``bfs.rs:25,94-98``).
+
+The whole run — expansion, routing, dedup, property kernels, termination —
+is one jitted ``shard_map`` with a ``lax.while_loop`` inside: zero host
+round-trips until the check finishes.  Collective-uniformity note: every
+branch decision inside the loop derives from replicated values (psum/pmax
+results), so all devices always execute the same collective sequence.
+
+Capacities are static; on overflow (table, frontier slice, or route bucket)
+the run restarts with that capacity doubled, as in ``wavefront.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 stable API
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+from ..checker.base import CheckerBuilder
+from ..core import Expectation
+from ..ops.hashing import EMPTY, row_hash
+from ..ops.hashtable import dedupe_sorted, hash_insert
+from ._base import WavefrontChecker
+
+def _to_varying(x):
+    """Mark a per-device array as varying over the mesh axis (vma typing).
+    Idempotent: already-varying arrays pass through."""
+    try:
+        if AXIS in jax.typeof(x).vma:
+            return x
+    except AttributeError:  # pragma: no cover - older jax without vma typing
+        pass
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (AXIS,), to="varying")
+    return jax.lax.pvary(x, AXIS)  # pragma: no cover - older jax
+
+
+_OK = 0
+_FRONTIER_OVERFLOW = 1
+_TABLE_OVERFLOW = 2
+_BUCKET_OVERFLOW = 3
+
+AXIS = "d"
+
+
+def _build_sharded_run(
+    tensor,
+    props,
+    mesh: Mesh,
+    cap_local: int,
+    fcap_local: int,
+    bucket_cap: int,
+    target: Optional[int],
+):
+    """Build the jitted whole-run shard_map for fixed per-device capacities."""
+    ndev = mesh.shape[AXIS]
+    width, arity = tensor.width, tensor.max_actions
+    n_props = len(props)
+    ev_idx = [i for i, p in enumerate(props) if p.expectation is Expectation.EVENTUALLY]
+    ebit_of = {i: e for e, i in enumerate(ev_idx)}
+    if len(ev_idx) > 32:
+        raise ValueError("at most 32 eventually properties are supported")
+    init_ebits = jnp.uint32((1 << len(ev_idx)) - 1)
+
+    init_rows_np = np.asarray(tensor.init_rows(), dtype=np.uint64)
+    n_init = init_rows_np.shape[0]
+    m_cand = fcap_local * arity
+
+    def owner_of(fps):
+        return ((fps >> jnp.uint64(32)) % jnp.uint64(ndev)).astype(jnp.int32)
+
+    # -- property kernels (cross-device: min-fp witness, deterministic) ------
+
+    def record_first(disc, i, hit, fps):
+        local = jnp.min(jnp.where(hit, fps, EMPTY))
+        glob = jax.lax.pmin(local, AXIS)
+        take = (disc[i] == jnp.uint64(0)) & (glob != EMPTY)
+        return disc.at[i].set(jnp.where(take, glob, disc[i]))
+
+    def eval_props(rows, fps, live, ebits, disc):
+        masks = tensor.property_masks(rows)  # [F, P] bool
+        for i, p in enumerate(props):
+            if p.expectation is Expectation.ALWAYS:
+                disc = record_first(disc, i, live & ~masks[..., i], fps)
+            elif p.expectation is Expectation.SOMETIMES:
+                disc = record_first(disc, i, live & masks[..., i], fps)
+            else:
+                clear = jnp.uint32(~(1 << ebit_of[i]) & 0xFFFFFFFF)
+                ebits = jnp.where(masks[..., i], ebits & clear, ebits)
+        return ebits, disc
+
+    def flush_terminal(terminal, fps, ebits, disc):
+        for i in ev_idx:
+            bit = (ebits >> jnp.uint32(ebit_of[i])) & jnp.uint32(1)
+            disc = record_first(disc, i, terminal & (bit == jnp.uint32(1)), fps)
+        return disc
+
+    def all_discovered(disc):
+        if n_props == 0:
+            return jnp.bool_(False)
+        return jnp.all(disc != jnp.uint64(0))
+
+    # -- all-to-all candidate routing ----------------------------------------
+
+    def route(cand_fp, cand_rows, cand_par, cand_ebits):
+        """Route candidates to their owner device.  Returns local views of the
+        received candidates plus a bucket-overflow flag."""
+        m = cand_fp.shape[0]
+        valid = cand_fp != EMPTY
+        owner = owner_of(cand_fp)
+        key = jnp.where(valid, owner, jnp.int32(ndev))
+        order = jnp.argsort(key, stable=True)
+        so = key[order]
+        starts = jnp.searchsorted(so, jnp.arange(ndev, dtype=jnp.int32))
+        rank = jnp.arange(m, dtype=jnp.int32) - starts[jnp.clip(so, 0, ndev - 1)]
+        ok = (so < ndev) & (rank < bucket_cap)
+        overflow = jnp.any((so < ndev) & (rank >= bucket_cap))
+        d_idx = jnp.where(ok, so, ndev)  # out-of-range rows drop
+        r_idx = jnp.where(ok, rank, 0)
+
+        def scatter(buf, vals):
+            return buf.at[d_idx, r_idx].set(vals[order], mode="drop")
+
+        send_fp = scatter(jnp.full((ndev, bucket_cap), EMPTY, jnp.uint64), cand_fp)
+        send_rows = scatter(
+            jnp.zeros((ndev, bucket_cap, width), jnp.uint64), cand_rows
+        )
+        send_par = scatter(jnp.zeros((ndev, bucket_cap), jnp.uint64), cand_par)
+        send_ebt = scatter(jnp.zeros((ndev, bucket_cap), jnp.uint32), cand_ebits)
+
+        a2a = lambda x: jax.lax.all_to_all(x, AXIS, 0, 0, tiled=False)
+        recv_fp = a2a(send_fp).reshape(ndev * bucket_cap)
+        recv_rows = a2a(send_rows).reshape(ndev * bucket_cap, width)
+        recv_par = a2a(send_par).reshape(ndev * bucket_cap)
+        recv_ebt = a2a(send_ebt).reshape(ndev * bucket_cap)
+        overflow = jax.lax.pmax(overflow, AXIS)
+        return recv_fp, recv_rows, recv_par, recv_ebt, overflow
+
+    # -- owner-side dedup + insert + compaction ------------------------------
+
+    def insert_and_compact(tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits):
+        """Dedup candidates, claim table slots, compact novel rows into a
+        frontier-shaped (exactly ``fcap_local``-row) buffer."""
+        m = cand_fp.shape[0]
+        order, first = dedupe_sorted(cand_fp)
+        sfp = cand_fp[order]
+        srows = cand_rows[order]
+        spar = cand_par[order]
+        sebt = cand_ebits[order]
+        tfp, tpl, novel, toverflow = hash_insert(tfp, tpl, sfp, spar, first)
+        n_new = jnp.sum(novel).astype(jnp.int32)
+        keys = jnp.where(novel, jnp.arange(m, dtype=jnp.int32), jnp.int32(m))
+        take = min(m, fcap_local)  # fewer candidates than frontier slots is fine
+        perm = jnp.argsort(keys)[:take]
+        nrows = srows[perm]
+        nfps = jnp.where(jnp.arange(take) < n_new, sfp[perm], EMPTY)
+        nebt = sebt[perm]
+        pad = fcap_local - take
+        if pad > 0:  # always emit exactly fcap_local rows (while_loop carry)
+            nrows = jnp.concatenate([nrows, jnp.zeros((pad, width), jnp.uint64)])
+            nfps = jnp.concatenate([nfps, jnp.full((pad,), EMPTY, jnp.uint64)])
+            nebt = jnp.concatenate([nebt, jnp.zeros((pad,), jnp.uint32)])
+        return tfp, tpl, nrows, nfps, nebt, n_new, toverflow
+
+    # -- the per-device program ----------------------------------------------
+
+    def device_program():
+        idx = jax.lax.axis_index(AXIS)
+
+        tfp = _to_varying(jnp.full((cap_local,), EMPTY, jnp.uint64))
+        tpl = _to_varying(jnp.zeros((cap_local,), jnp.uint64))
+
+        # Each device claims the init states it owns (no routing needed: the
+        # init set is a replicated constant).
+        irows = jnp.asarray(init_rows_np)
+        ifp = row_hash(irows)
+        mine = owner_of(ifp) == idx
+        cand_fp = jnp.where(mine, ifp, EMPTY)
+        cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = init state
+        cand_ebt = jnp.full((n_init,), init_ebits, jnp.uint32)
+        tfp, tpl, rows0, fps0, ebt0, n_new, toverflow = insert_and_compact(
+            tfp, tpl, irows, cand_fp, cand_par, cand_ebt
+        )
+        unique = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
+        foverflow = n_new > fcap_local
+        status = jnp.where(
+            jax.lax.pmax(toverflow, AXIS),
+            jnp.int32(_TABLE_OVERFLOW),
+            jnp.where(
+                jax.lax.pmax(foverflow, AXIS),
+                jnp.int32(_FRONTIER_OVERFLOW),
+                jnp.int32(_OK),
+            ),
+        )
+        go = (status == _OK) & (unique > 0)
+        if target is not None:
+            go = go & (unique < jnp.int64(target))
+
+        def body(carry):
+            (tfp, tpl, rows, fps, ebits, unique, scount, disc, depth, status, go) = carry
+            live = fps != EMPTY
+            ebits, disc = eval_props(rows, fps, live, ebits, disc)
+            # Mid-block early exit (reference ``bfs.rs:121-128``): mask the
+            # expansion instead of branching so the collective sequence stays
+            # uniform across devices.
+            elive = live & ~all_discovered(disc)
+
+            succ, valid = tensor.step_rows(rows)  # [F, A, W], [F, A]
+            valid = valid & elive[:, None]
+            scount = scount + jax.lax.psum(jnp.sum(valid, dtype=jnp.int64), AXIS)
+            terminal = elive & ~jnp.any(valid, axis=-1)
+            disc = flush_terminal(terminal, fps, ebits, disc)
+
+            cand_fp = jnp.where(valid, row_hash(succ), EMPTY).reshape(m_cand)
+            cand_rows = succ.reshape(m_cand, width)
+            cand_par = jnp.broadcast_to(fps[:, None], (fcap_local, arity)).reshape(-1)
+            cand_ebt = jnp.broadcast_to(ebits[:, None], (fcap_local, arity)).reshape(-1)
+
+            rfp, rrows, rpar, rebt, boverflow = route(
+                cand_fp, cand_rows, cand_par, cand_ebt
+            )
+            tfp, tpl, nrows, nfps, nebt, n_new, toverflow = insert_and_compact(
+                tfp, tpl, rrows, rfp, rpar, rebt
+            )
+            n_new_g = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
+            unique = unique + n_new_g
+            foverflow = jax.lax.pmax(n_new > fcap_local, AXIS)
+            toverflow = jax.lax.pmax(toverflow, AXIS)
+            status = jnp.where(
+                toverflow,
+                jnp.int32(_TABLE_OVERFLOW),
+                jnp.where(
+                    boverflow,
+                    jnp.int32(_BUCKET_OVERFLOW),
+                    jnp.where(foverflow, jnp.int32(_FRONTIER_OVERFLOW), status),
+                ),
+            )
+            depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
+            go = (status == _OK) & (n_new_g > 0) & ~all_discovered(disc)
+            if target is not None:
+                go = go & (unique < jnp.int64(target))
+            return (tfp, tpl, nrows, nfps, nebt, unique, scount, disc, depth, status, go)
+
+        carry = (
+            tfp,
+            tpl,
+            rows0,
+            fps0,
+            ebt0,
+            unique,
+            jnp.int64(n_init),  # state_count counts all inits (bfs parity)
+            jnp.zeros((max(n_props, 1),), jnp.uint64),
+            jnp.int32(0),
+            status,
+            go,
+        )
+        # Device-local carry components must enter the loop as "varying" over
+        # the mesh axis even when their initial value is a replicated constant
+        # (shard_map's vma typing for while_loop).
+        carry = tuple(_to_varying(x) for x in carry[:5]) + carry[5:]
+        carry = jax.lax.while_loop(lambda c: c[-1], body, carry)
+        (tfp, tpl, _, _, _, unique, scount, disc, depth, status, _) = carry
+        return tfp, tpl, unique, scount, disc, depth, status
+
+    sharded = shard_map(
+        device_program,
+        mesh,
+        in_specs=(),
+        out_specs=(P(AXIS), P(AXIS), P(), P(), P(), P(), P()),
+    )
+    return jax.jit(sharded)
+
+
+def default_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (AXIS,))
+
+
+class ShardedTpuChecker(WavefrontChecker):
+    """Wavefront BFS sharded over a device mesh (TPU ICI on hardware; in tests
+    an 8-device virtual CPU mesh).  Same result surface and restart-on-overflow
+    behavior as the single-device :class:`~.wavefront.TpuChecker`."""
+
+    def __init__(
+        self,
+        options: CheckerBuilder,
+        mesh: Optional[Mesh] = None,
+        n_devices: Optional[int] = None,
+        capacity: int = 1 << 17,
+        frontier_capacity: int = 1 << 13,
+        bucket_factor: int = 2,
+        sync: bool = False,
+    ):
+        self.mesh = mesh if mesh is not None else default_mesh(n_devices)
+        self.ndev = self.mesh.shape[AXIS]
+        # capacities are global; divide into power-of-two per-device shards
+        self._cap_local = max(64, _pow2(capacity // self.ndev))
+        self._fcap_local = max(16, frontier_capacity // self.ndev)
+        self._bucket_factor = bucket_factor
+        self._init_common(options, sync)
+
+    def _run(self):
+        cap, fcap, bf = self._cap_local, self._fcap_local, self._bucket_factor
+        arity = self.tensor.max_actions
+        cache = getattr(self.tensor, "_sharded_run_cache", None)
+        if cache is None:
+            cache = {}
+            self.tensor._sharded_run_cache = cache
+        mesh_key = tuple(d.id for d in self.mesh.devices.flat)
+        while True:
+            bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
+            key = (mesh_key, cap, fcap, bucket_cap, self._target)
+            run = cache.get(key)
+            if run is None:
+                run = _build_sharded_run(
+                    self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
+                    self._target,
+                )
+                cache[key] = run
+            tfp, tpl, unique, scount, disc, depth, status = run()
+            status = int(status)
+            if status == _TABLE_OVERFLOW:
+                cap *= 2
+                continue
+            if status == _FRONTIER_OVERFLOW:
+                fcap *= 2
+                continue
+            if status == _BUCKET_OVERFLOW:
+                bf *= 2
+                continue
+            break
+        self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf
+        self._results = {
+            "unique": int(unique),
+            "states": int(scount),
+            "disc": np.asarray(disc),
+            "depth": int(depth),
+            "table_fp": np.asarray(tfp),
+            "table_parent": np.asarray(tpl),
+        }
+        self._done.set()
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

@@ -32,7 +32,6 @@ bounded by a constant factor).
 
 from __future__ import annotations
 
-import threading
 from functools import partial
 from typing import Optional
 
@@ -41,12 +40,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..checker.base import Checker, CheckerBuilder
-from ..checker.path import Path
+from ..checker.base import CheckerBuilder
 from ..core import Expectation
-from ..fingerprint import MASK64
 from ..ops.hashing import EMPTY, row_hash
 from ..ops.hashtable import dedupe_sorted, hash_insert
+from ._base import WavefrontChecker
 
 _STATUS_OK = 0
 _STATUS_FRONTIER_OVERFLOW = 1
@@ -215,7 +213,7 @@ def _build_run(tensor, props, cap: int, fcap: int, target: Optional[int]):
     return run
 
 
-class TpuChecker(Checker):
+class TpuChecker(WavefrontChecker):
     """Wavefront BFS on the default JAX device (TPU on hardware, CPU in tests).
 
     Requires the model to provide a tensor twin via ``model.tensor_model()``
@@ -230,56 +228,9 @@ class TpuChecker(Checker):
         frontier_capacity: int = 1 << 12,
         sync: bool = False,
     ):
-        self.model = options.model
-        tensor = getattr(self.model, "tensor_model", lambda: None)()
-        if tensor is None:
-            raise TypeError(
-                f"{type(self.model).__name__} has no tensor form: implement "
-                "tensor_model() (see parallel/tensor_model.py) or use "
-                "spawn_bfs()/spawn_dfs()"
-            )
-        if options.symmetry_fn is not None:
-            raise NotImplementedError(
-                "symmetry reduction on the TPU engine is not supported yet; "
-                "use spawn_dfs()"
-            )
-        if options.visitor_obj is not None:
-            raise NotImplementedError(
-                "per-state visitors require host materialization; use "
-                "spawn_bfs() (the TPU engine never materializes states)"
-            )
-        self.tensor = tensor
-        self._props = list(self.model.properties())
-        self._target = options.target_state_count
         self._cap = capacity
         self._fcap = frontier_capacity
-        self._verify_fingerprint_bridge()
-
-        self._results = None
-        self._parent_map: Optional[dict[int, int]] = None
-        self._done = threading.Event()
-        self._thread = None
-        if sync:
-            self._run()
-        else:
-            self._thread = threading.Thread(target=self._run, daemon=True)
-            self._thread.start()
-
-    def _verify_fingerprint_bridge(self):
-        """Host fingerprint must equal the device row hash, else traces cannot
-        be reconstructed (the tensor analogue of the reference's
-        nondeterminism diagnostics, ``path.rs:35-49``)."""
-        for s in self.model.init_states():
-            host_fp = self.model.fingerprint_state(s)
-            row = np.asarray([self.tensor.encode_state(s)], dtype=np.uint64)
-            dev_fp = int(np.asarray(row_hash(jnp.asarray(row)))[0])
-            if host_fp != dev_fp:
-                raise RuntimeError(
-                    "model.fingerprint_state disagrees with the device row "
-                    "hash; tensor-backed models must fingerprint via their "
-                    "row encoding (mix in TensorBackedModel)"
-                )
-            break
+        self._init_common(options, sync)
 
     # -- run loop ------------------------------------------------------------
 
@@ -316,55 +267,3 @@ class TpuChecker(Checker):
             "table_parent": tpl,
         }
         self._done.set()
-
-    # -- Checker surface -----------------------------------------------------
-
-    def is_done(self) -> bool:
-        return self._done.is_set()
-
-    def join(self) -> "TpuChecker":
-        if self._thread is not None:
-            self._thread.join()
-        return self
-
-    def state_count(self) -> int:
-        return self._results["states"] if self._results else 0
-
-    def unique_state_count(self) -> int:
-        return self._results["unique"] if self._results else 0
-
-    def max_depth(self) -> int:
-        return self._results["depth"] if self._results else 0
-
-    def _parents(self) -> dict[int, int]:
-        if self._parent_map is None:
-            tfp = np.asarray(self._results["table_fp"])
-            tpl = np.asarray(self._results["table_parent"])
-            occupied = tfp != np.uint64(MASK64)
-            self._parent_map = dict(
-                zip(tfp[occupied].tolist(), tpl[occupied].tolist())
-            )
-        return self._parent_map
-
-    def _trace(self, fp: int) -> list[int]:
-        parents = self._parents()
-        fps = [fp]
-        while True:
-            parent = parents.get(fps[-1], 0)
-            if parent == 0:
-                break
-            fps.append(parent)
-        fps.reverse()
-        return fps
-
-    def discoveries(self) -> dict[str, Path]:
-        self.join()
-        disc = self._results["disc"]
-        out = {}
-        for i, prop in enumerate(self._props):
-            fp = int(disc[i])
-            if fp != 0:
-                out[prop.name] = Path.from_fingerprints(
-                    self.model, self._trace(fp)
-                )
-        return out

@@ -3,17 +3,31 @@
 Tests never assume real TPU hardware: JAX is forced onto CPU with 8 virtual
 devices so multi-chip sharding (mesh + all-to-all fingerprint routing) is
 exercised exactly as the driver's ``dryrun_multichip`` does.  Must run before
-jax is imported anywhere.
+jax is used anywhere.
+
+Note the env override must be unconditional: the environment may arrive with
+``JAX_PLATFORMS`` already pointing at a real accelerator plugin, and a
+``setdefault`` would silently leave the whole suite running on one real chip.
+``jax.config.update`` additionally beats any plugin that force-selected its
+platform at interpreter startup (site hooks run before this file).
 """
 
 import os
+import re
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
