@@ -1,0 +1,96 @@
+"""Benchmark: TPU wavefront engine vs the CPU BFS baseline.
+
+Protocol (mirrors the reference's ``bench.sh`` wall-clock discipline, measured
+from the checker's own run, reference ``src/checker.rs:230-233``):
+
+ 1. Parity gate on ``2pc check 5``: the TPU engine and the CPU oracle must
+    agree on unique-state counts and discoveries (reference parity bar,
+    ``examples/2pc.rs:125-140``).
+ 2. CPU baseline: multithreaded BFS on ``2pc check 6`` -> states/sec.
+ 3. TPU engine: wavefront check on ``2pc check 7`` (~2.7M generated states)
+    -> states/sec.  A warm-up run amortizes jit compilation, as recommended
+    for XLA benchmarking; the timed run uses the cached executable.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"states" counts generated states including duplicates, matching the
+reference's ``states=`` counter semantics (``bfs.rs:235``).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _time_run(spawn):
+    t0 = time.monotonic()
+    checker = spawn()
+    checker.join()
+    dt = max(time.monotonic() - t0, 1e-9)
+    return checker, dt
+
+
+def main():
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    # -- 1. parity gate ------------------------------------------------------
+    sys5 = TwoPhaseSys(5)
+    cpu5 = sys5.checker().spawn_bfs().join()
+    tpu5 = sys5.checker().spawn_tpu(sync=True, capacity=1 << 17)
+    parity = (
+        cpu5.unique_state_count() == tpu5.unique_state_count() == 8832
+        and set(cpu5.discoveries()) == set(tpu5.discoveries())
+    )
+    if not parity:
+        print(
+            json.dumps(
+                {
+                    "metric": "2pc states/sec (TPU wavefront)",
+                    "value": 0.0,
+                    "unit": "states/sec",
+                    "vs_baseline": 0.0,
+                    "error": "parity gate failed",
+                    "cpu_unique": cpu5.unique_state_count(),
+                    "tpu_unique": tpu5.unique_state_count(),
+                }
+            )
+        )
+        return 1
+
+    # -- 2. CPU baseline (multithreaded BFS, reference's baseline shape) -----
+    sys6 = TwoPhaseSys(6)
+    cpu6, cpu_dt = _time_run(
+        lambda: sys6.checker().threads(os.cpu_count() or 1).spawn_bfs()
+    )
+    cpu_sps = cpu6.state_count() / cpu_dt
+
+    # -- 3. TPU wavefront on the large workload ------------------------------
+    sys7 = TwoPhaseSys(7)
+    caps = dict(capacity=1 << 21, frontier_capacity=1 << 15)
+    # warm-up: compile (cached on the tensor model keyed by capacities)
+    sys7.checker().spawn_tpu(sync=True, **caps)
+    tpu7, tpu_dt = _time_run(lambda: sys7.checker().spawn_tpu(sync=True, **caps))
+    tpu_sps = tpu7.state_count() / tpu_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "2pc check 7 states/sec (TPU wavefront)",
+                "value": round(tpu_sps, 1),
+                "unit": "states/sec",
+                "vs_baseline": round(tpu_sps / cpu_sps, 3),
+                "tpu_states": tpu7.state_count(),
+                "tpu_unique": tpu7.unique_state_count(),
+                "tpu_sec": round(tpu_dt, 3),
+                "cpu_states_per_sec": round(cpu_sps, 1),
+                "cpu_states": cpu6.state_count(),
+                "cpu_sec": round(cpu_dt, 3),
+                "parity": "2pc check 5: unique=8832 + discoveries match",
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ from ..actor.register import (
     record_returns,
     value_chosen,
 )
+from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import default_threads, run_cli
 
@@ -181,14 +182,42 @@ class PaxosServer(Actor):
         return None
 
 
+class PaxosModel(TensorBackedModel, ActorModel):
+    """ActorModel specialization carrying a tensor (device) twin for the
+    benchmark configuration — 3 servers, 1..3 clients doing one put each,
+    unordered non-duplicating lossless network (see ``paxos_tensor.py``).
+    Eligibility is derived from the live builder state; other configurations
+    fall back to structural fingerprints and CPU checking."""
+
+    def tensor_model(self):
+        from ..actor.network import UnorderedNonDuplicatingNetwork
+        from .paxos_tensor import PaxosTensor
+
+        servers = sum(isinstance(a, PaxosServer) for a in self.actors)
+        clients = self.actors[servers:]
+        if (
+            servers != 3
+            or not 1 <= len(clients) <= 3
+            or not all(
+                isinstance(a, RegisterClient) and a.put_count == 1
+                for a in clients
+            )
+            or self.lossy
+            or not isinstance(self.init_network, UnorderedNonDuplicatingNetwork)
+        ):
+            return None
+        return PaxosTensor(self, len(clients))
+
+
 def paxos_model(
     client_count: int, server_count: int = 3, network: Optional[Network] = None
 ) -> ActorModel:
     """Build the checked system (reference ``paxos.rs:231-266``)."""
     if network is None:
         network = Network.new_unordered_nonduplicating()
-    m = ActorModel(
-        cfg=None, init_history=LinearizabilityTester(Register(NULL_VALUE))
+    m = PaxosModel(
+        cfg=None,
+        init_history=LinearizabilityTester(Register(NULL_VALUE)),
     )
     for i in range(server_count):
         m.actor(PaxosServer(peer_ids=model_peers(i, server_count)))

@@ -1,0 +1,131 @@
+"""Device encoding of the in-model network: a sorted-slot multiset.
+
+The reference's unordered non-duplicating network is a multiset of envelopes
+(``src/actor/network.rs:188-190``).  The tensor form (SURVEY §7.3(1): the
+hardest encoding problem) packs each *distinct* envelope into one ``uint64``
+slot word::
+
+    slot = envelope_code << COUNT_BITS | count      (EMPTY = 2^64-1 if free)
+
+and keeps the slot array sorted ascending, so equal multisets produce equal
+words in equal positions — the canonical-order property the reference gets
+for free from order-insensitive hashing (``src/util.rs:124-145``).  Because
+``envelope_code`` occupies the high bits and equal multisets have equal
+counts per code, sorting by the whole word is sorting by code.
+
+Device ops (all pure, jittable, batched over leading axes):
+
+ - :func:`slot_deliver` — decrement count at a slot index; free at zero.
+ - :func:`slot_send` — increment an existing code's count or claim a free
+   slot (the caller re-sorts once per step via :func:`slot_canonicalize`).
+ - :func:`slot_canonicalize` — re-sort so EMPTY slots sink to the end.
+
+Host-side, :class:`SlotCodec` mirrors the packing for ``encode_state`` /
+``decode_state`` bridges.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import jax.numpy as jnp
+
+from ..fingerprint import MASK64
+
+COUNT_BITS = 6
+COUNT_MASK = (1 << COUNT_BITS) - 1
+SLOT_EMPTY = MASK64
+
+
+class SlotCodec:
+    """Host-side slot packing over an envelope⇄code bijection."""
+
+    def __init__(
+        self,
+        n_slots: int,
+        encode_env: Callable,  # Envelope -> int code
+        decode_env: Callable,  # int code -> Envelope
+    ):
+        self.n_slots = n_slots
+        self.encode_env = encode_env
+        self.decode_env = decode_env
+
+    def pack(self, env_counts: Iterable[tuple]) -> tuple:
+        """``[(envelope, count), ...] -> sorted slot words``."""
+        words = []
+        for env, count in env_counts:
+            if not 1 <= count <= COUNT_MASK:
+                raise ValueError(f"count {count} out of range for {env!r}")
+            words.append((self.encode_env(env) << COUNT_BITS) | count)
+        if len(words) > self.n_slots:
+            raise ValueError(
+                f"{len(words)} distinct envelopes exceed {self.n_slots} slots"
+            )
+        words.sort()
+        words += [SLOT_EMPTY] * (self.n_slots - len(words))
+        return tuple(words)
+
+    def unpack(self, words) -> list[tuple]:
+        """``slot words -> [(envelope, count), ...]``"""
+        out = []
+        for w in words:
+            w = int(w)
+            if w == SLOT_EMPTY:
+                continue
+            out.append((self.decode_env(w >> COUNT_BITS), w & COUNT_MASK))
+        return out
+
+
+def slot_counts(slots):
+    return slots & jnp.uint64(COUNT_MASK)
+
+
+def slot_codes(slots):
+    return slots >> jnp.uint64(COUNT_BITS)
+
+
+def slot_occupied(slots):
+    return slots != jnp.uint64(SLOT_EMPTY)
+
+
+def slot_deliver(slots, index: int):
+    """Consume one instance of the envelope in slot ``index`` (static index;
+    batched over leading axes).  Caller must ensure the slot is occupied.
+    Returns un-canonicalized slots."""
+    w = slots[..., index]
+    count = w & jnp.uint64(COUNT_MASK)
+    neww = jnp.where(
+        count <= jnp.uint64(1), jnp.uint64(SLOT_EMPTY), w - jnp.uint64(1)
+    )
+    return slots.at[..., index].set(neww)
+
+
+def slot_send(slots, code, enable):
+    """Add one instance of ``code`` (uint64[...]) where ``enable`` (bool[...]).
+
+    Existing code -> count+1; else claim the first free slot (one-hot
+    scatter, so repeated sends compose without re-sorting in between; the
+    caller canonicalizes once per step).  Returns (slots, overflow):
+    ``overflow`` is True where enable is set but no slot was available.
+    """
+    n = slots.shape[-1]
+    match = slot_occupied(slots) & (slot_codes(slots) == code[..., None])
+    exists = jnp.any(match, axis=-1)
+    bumped = jnp.where(match & enable[..., None], slots + jnp.uint64(1), slots)
+
+    free = ~slot_occupied(slots)
+    first_free = jnp.argmax(free, axis=-1)  # 0 if none free; gated below
+    any_free = jnp.any(free, axis=-1)
+    claim = enable & ~exists & any_free
+    onehot = (
+        jnp.arange(n) == first_free[..., None]
+    ) & claim[..., None]
+    neww = (code << jnp.uint64(COUNT_BITS)) | jnp.uint64(1)
+    claimed = jnp.where(onehot, neww[..., None], bumped)
+    overflow = enable & ~exists & ~any_free
+    return claimed, overflow
+
+
+def slot_canonicalize(slots):
+    """Sort slots ascending; EMPTY (all-ones) sinks to the end."""
+    return jnp.sort(slots, axis=-1)

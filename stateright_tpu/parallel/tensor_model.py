@@ -74,18 +74,28 @@ class TensorBackedModel:
     Overrides ``fingerprint_state`` to the row hash so every backend (CPU
     BFS/DFS, TPU wavefront, Explorer URLs) agrees on state identity, the way
     the reference's single stable hash does (``src/lib.rs:302-344``).
+
+    ``tensor_model()`` may return None for configurations without a device
+    twin (e.g. an unsupported network semantics); fingerprints then fall back
+    to the base model's structural hash.  The verdict is cached on first use,
+    so eligibility is frozen once checking starts — configure the model fully
+    before fingerprinting.
     """
+
+    _TENSOR_UNRESOLVED = "unresolved"
 
     def tensor_model(self) -> Optional[TensorModel]:
         raise NotImplementedError
 
     def fingerprint_state(self, state) -> int:
         tm = self._tensor_cached()
+        if tm is None:
+            return super().fingerprint_state(state)
         return hash_words(tm.encode_state(state))
 
-    def _tensor_cached(self) -> TensorModel:
-        tm = getattr(self, "_tensor_model_cache", None)
-        if tm is None:
+    def _tensor_cached(self) -> Optional[TensorModel]:
+        tm = getattr(self, "_tensor_model_cache", self._TENSOR_UNRESOLVED)
+        if tm is self._TENSOR_UNRESOLVED:
             tm = self.tensor_model()
             object.__setattr__(self, "_tensor_model_cache", tm)
         return tm
