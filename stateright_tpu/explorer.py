@@ -1,0 +1,260 @@
+"""The Explorer: a web UI for interactively browsing a state space.
+
+HTTP surface mirrors the reference server (``src/checker/explorer.rs``):
+
+ - ``GET /.status`` — JSON ``{done, model, state_count, unique_state_count,
+   properties: [[expectation, name, encoded_discovery_path|null], ...],
+   recent_path}`` (reference ``StatusView``, ``explorer.rs:12-22,133-157``).
+ - ``GET /.states/`` — one view per init state (``explorer.rs:186-198``).
+ - ``GET /.states/{fp1}/{fp2}/...`` — follows the fingerprint path by
+   re-executing the model (``Path.from_fingerprints``), then returns one view
+   per enabled action of the final state: ``{action, outcome, state,
+   fingerprint, svg}``; ignored (no-op) actions are returned with no state,
+   "as it may be useful for debugging" (``explorer.rs:199-232``); unknown
+   fingerprints give 404 (``explorer.rs:233-237``).
+ - ``GET /`` — the bundled single-page UI (``ui/``; ours, not the
+   reference's).
+
+Checking runs concurrently: ``serve()`` attaches a rate-limited snapshot
+visitor that records the most recently visited path (reference
+``explorer.rs:57-88``), spawns a BFS check, and serves HTTP over it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path as FsPath
+from typing import Optional
+
+from .checker.path import Path
+from .checker.visitor import CheckerVisitor
+from .core import Expectation
+
+_UI_DIR = FsPath(__file__).parent / "ui"
+_SNAPSHOT_INTERVAL = 4.0  # seconds between recent-path refreshes
+
+
+class _Snapshot(CheckerVisitor):
+    """Keeps the most recently visited path, refreshed at most every
+    :data:`_SNAPSHOT_INTERVAL` seconds (reference ``explorer.rs:57-84``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last_update = 0.0
+        self.recent_path: Optional[str] = None
+
+    def visit(self, model, path) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_update < _SNAPSHOT_INTERVAL and self.recent_path:
+                return
+            self._last_update = now
+            self.recent_path = (
+                "[" + ", ".join(model.format_action(a) for a in path.actions()) + "]"
+            )
+
+
+_EXPECTATION_NAME = {
+    Expectation.ALWAYS: "always",
+    Expectation.SOMETIMES: "sometimes",
+    Expectation.EVENTUALLY: "eventually",
+}
+
+
+def _status_view(model, checker, snapshot: _Snapshot) -> dict:
+    # discoveries() joins the check, so only read once done; discovery links
+    # appear in the UI when the run finishes
+    discoveries = checker.discoveries() if checker.is_done() else {}
+    props = []
+    for prop in model.properties():
+        path = discoveries.get(prop.name)
+        props.append(
+            [
+                _EXPECTATION_NAME[prop.expectation],
+                prop.name,
+                path.encode(model) if path is not None else None,
+            ]
+        )
+    return {
+        "done": checker.is_done(),
+        "model": type(model).__name__,
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "properties": props,
+        "recent_path": snapshot.recent_path,
+    }
+
+
+def _pretty(state) -> str:
+    return _indent_repr(repr(state))
+
+
+def _indent_repr(text: str, max_width: int = 100) -> str:
+    """Break a long repr into an indented multi-line form (stands in for
+    Rust's ``{:#?}`` pretty debug formatting, ``explorer.rs:47``)."""
+    if len(text) <= max_width:
+        return text
+    out: list[str] = []
+    depth = 0
+    at_line_start = False
+    for ch in text:
+        if at_line_start and ch == " ":
+            continue  # swallow pre-existing spacing after our line breaks
+        at_line_start = False
+        if ch in ")]}":
+            depth = max(depth - 1, 0)
+            out.append("\n" + "  " * depth)
+        out.append(ch)
+        if ch in "([{":
+            depth += 1
+            out.append("\n" + "  " * depth)
+            at_line_start = True
+        elif ch == ",":
+            out.append("\n" + "  " * depth)
+            at_line_start = True
+    return "".join(out)
+
+
+def _state_views(model, fingerprints: list[int]) -> Optional[list[dict]]:
+    """Build the step views for ``/.states``; None means 404."""
+    views: list[dict] = []
+    if not fingerprints:
+        for state in model.init_states():
+            fp = model.fingerprint_state(state)
+            svg = model.as_svg(Path([(state, None)]))
+            view = {"state": _pretty(state), "fingerprint": str(fp)}
+            if svg:
+                view["svg"] = svg
+            views.append(view)
+        return views
+    try:
+        path = Path.from_fingerprints(model, fingerprints)
+    except RuntimeError:
+        return None
+    last_state = path.final_state()
+    prefix = path.into_vec()[:-1]  # [(state, action), ...] up to last_state
+    for action in model.actions(last_state):
+        outcome = model.format_step(last_state, action)
+        nxt = model.next_state(last_state, action)
+        if nxt is not None:
+            fp = model.fingerprint_state(nxt)
+            view = {
+                "action": model.format_action(action),
+                "state": _pretty(nxt),
+                "fingerprint": str(fp),
+            }
+            if outcome is not None:
+                view["outcome"] = outcome
+            # child path built by appending, not by re-executing from init
+            svg = model.as_svg(Path(prefix + [(last_state, action), (nxt, None)]))
+            if svg:
+                view["svg"] = svg
+        else:
+            # ignored action: still listed, for debugging (explorer.rs:225)
+            view = {"action": model.format_action(action)}
+        views.append(view)
+    return views
+
+
+def _make_handler(model, checker, snapshot: _Snapshot):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, body: bytes, ctype: str):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, obj, code: int = 200):
+            self._send(code, json.dumps(obj).encode(), "application/json")
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/.status":
+                self._send_json(_status_view(model, checker, snapshot))
+                return
+            if path == "/.states" or path.startswith("/.states/"):
+                raw = path[len("/.states") :].strip("/")
+                fps: list[int] = []
+                if raw:
+                    for part in raw.split("/"):
+                        try:
+                            fps.append(int(part))
+                        except ValueError:
+                            self._send_json(
+                                {"error": f"Unable to parse fingerprints {raw}"},
+                                404,
+                            )
+                            return
+                views = _state_views(model, fps)
+                if views is None:
+                    self._send_json(
+                        {
+                            "error": "Unable to find state following "
+                            f"fingerprints {raw}"
+                        },
+                        404,
+                    )
+                    return
+                self._send_json(views)
+                return
+            # static UI
+            name = {
+                "/": "index.html",
+                "/app.js": "app.js",
+                "/app.css": "app.css",
+            }.get(path)
+            if name is None:
+                self._send(404, b"not found", "text/plain")
+                return
+            f = _UI_DIR / name
+            ctype = {
+                "index.html": "text/html",
+                "app.js": "application/javascript",
+                "app.css": "text/css",
+            }[name]
+            self._send(200, f.read_bytes(), ctype)
+
+    return Handler
+
+
+class ExplorerServer:
+    """A running Explorer; ``addr`` like ``"localhost:3000"``."""
+
+    def __init__(self, builder, addr: str = "localhost:3000"):
+        host, _, port = addr.partition(":")
+        self.snapshot = _Snapshot()
+        self.checker = builder.visitor(self.snapshot).spawn_bfs()
+        self.model = builder.model
+        handler = _make_handler(self.model, self.checker, self.snapshot)
+        self.httpd = ThreadingHTTPServer((host, int(port or "3000")), handler)
+        self.addr = f"{self.httpd.server_address[0]}:{self.httpd.server_address[1]}"
+
+    def serve_forever(self):
+        print(f"Exploring state space at http://{self.addr}")
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "ExplorerServer":
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve(builder, addr: str = "localhost:3000", block: bool = True):
+    """Spawn a BFS check over ``builder`` and serve the Explorer UI
+    (reference ``checker.rs:108-114``)."""
+    server = ExplorerServer(builder, addr)
+    if block:
+        server.serve_forever()
+        return server
+    return server.start_background()

@@ -27,7 +27,14 @@ class WavefrontChecker(Checker):
 
     def _init_common(self, options: CheckerBuilder, sync: bool):
         self.model = options.model
-        tensor = getattr(self.model, "tensor_model", lambda: None)()
+        # Prefer the cached twin (TensorBackedModel): the compiled-run cache
+        # lives on the tensor instance, so a fresh twin per checker would
+        # recompile on every run.
+        cached = getattr(self.model, "_tensor_cached", None)
+        if cached is not None:
+            tensor = cached()
+        else:
+            tensor = getattr(self.model, "tensor_model", lambda: None)()
         if tensor is None:
             raise TypeError(
                 f"{type(self.model).__name__} has no tensor form: implement "

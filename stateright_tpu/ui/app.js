@@ -1,0 +1,201 @@
+/* State Space Explorer front-end.
+ *
+ * Talks to the two JSON endpoints served by explorer.py:
+ *   GET /.status            -> header + property summaries
+ *   GET /.states/<fp>/<fp>  -> steps available from the state at that path
+ *
+ * The current position is the URL hash: #/steps/<fp>/<fp>/... so paths are
+ * shareable and survive reloads (same contract as the reference UI's
+ * hash-routing, but this implementation is our own).
+ */
+"use strict";
+
+const $ = (id) => document.getElementById(id);
+let currentPath = [];      // fingerprints (strings)
+let currentViews = [];     // step views at the current path
+let stateOfPath = null;    // pretty state text of the current position
+let selected = -1;
+
+// ---------------------------------------------------------------- status --
+async function pollStatus() {
+  try {
+    const r = await fetch("/.status");
+    const s = await r.json();
+    $("model-name").textContent = "— " + s.model;
+    $("progress").textContent =
+      (s.done ? "done" : "checking…") +
+      "  states=" + s.state_count.toLocaleString() +
+      "  unique=" + s.unique_state_count.toLocaleString();
+    $("recent-path").textContent = s.recent_path || "—";
+    renderProperties(s.properties, s.done);
+  } catch (e) {
+    $("progress").textContent = "server unreachable";
+  }
+}
+
+function renderProperties(props, done) {
+  const ul = $("properties");
+  ul.innerHTML = "";
+  for (const [kind, name, discovery] of props) {
+    const li = document.createElement("li");
+    const k = document.createElement("span");
+    k.className = "prop-kind";
+    k.textContent = kind;
+    const n = document.createElement("span");
+    n.textContent = name;
+    const flag = document.createElement("span");
+    flag.className = "prop-flag";
+    if (discovery) {
+      const a = document.createElement("a");
+      a.href = "#/steps/" + discovery;
+      // a discovery is good news for `sometimes`, bad otherwise
+      const good = kind === "sometimes";
+      flag.classList.add(good ? "flag-ok" : "flag-bad");
+      a.textContent = good ? "example ↗" : "counterexample ↗";
+      flag.appendChild(a);
+    } else if (done) {
+      const good = kind !== "sometimes";
+      flag.classList.add(good ? "flag-ok" : "flag-bad");
+      flag.textContent = good ? "holds ✓" : "unsatisfied ✗";
+    } else {
+      flag.classList.add("flag-pending");
+      flag.textContent = "…";
+    }
+    li.append(k, n, flag);
+    ul.appendChild(li);
+  }
+}
+
+// ----------------------------------------------------------------- steps --
+let loadSeq = 0; // drop out-of-order responses so fast navigation stays sane
+
+async function loadPath(path) {
+  const seq = ++loadSeq;
+  const url = "/.states/" + path.join("/");
+  const r = await fetch(url);
+  if (seq !== loadSeq) return; // a newer navigation superseded this one
+  if (!r.ok) {
+    $("steps-title").textContent = "error";
+    $("steps").innerHTML = "<li class='ignored'>path not found</li>";
+    return;
+  }
+  currentPath = path;
+  currentViews = await r.json();
+  if (seq !== loadSeq) return;
+  selected = currentViews.length ? 0 : -1;
+  // resolve the pretty text of the state we are AT (deep links included):
+  // it is the view with our last fingerprint in the parent path's step list
+  if (path.length) {
+    const pr = await fetch("/.states/" + path.slice(0, -1).join("/"));
+    if (seq !== loadSeq) return;
+    if (pr.ok) {
+      const parentViews = await pr.json();
+      if (seq !== loadSeq) return;
+      const me = parentViews.find((v) => v.fingerprint === path[path.length - 1]);
+      stateOfPath = me ? me.state : null;
+      $("svg-panel").innerHTML = me && me.svg ? me.svg : "";
+    }
+  } else {
+    stateOfPath = null;
+    $("svg-panel").innerHTML = "";
+  }
+  renderBreadcrumb();
+  renderSteps();
+}
+
+function renderBreadcrumb() {
+  const nav = $("breadcrumb");
+  nav.innerHTML = "";
+  const root = document.createElement("a");
+  root.href = "#/steps";
+  root.textContent = "⌂ init";
+  nav.appendChild(root);
+  currentPath.forEach((fp, i) => {
+    const sep = document.createElement("span");
+    sep.className = "crumb-sep";
+    sep.textContent = "→";
+    nav.appendChild(sep);
+    const a = document.createElement("a");
+    a.href = "#/steps/" + currentPath.slice(0, i + 1).join("/");
+    a.textContent = "…" + fp.slice(-6);
+    a.title = fp;
+    nav.appendChild(a);
+  });
+}
+
+function renderSteps() {
+  $("steps-title").textContent = currentPath.length
+    ? "Next steps (" + currentViews.length + ")"
+    : "Init states (" + currentViews.length + ")";
+  const ol = $("steps");
+  ol.innerHTML = "";
+  currentViews.forEach((v, i) => {
+    const li = document.createElement("li");
+    if (v.fingerprint === undefined) li.classList.add("ignored");
+    if (i === selected) li.classList.add("selected");
+    const action = document.createElement("div");
+    action.className = "step-action";
+    action.textContent = v.action !== undefined ? v.action : "(init)";
+    li.appendChild(action);
+    if (v.outcome !== undefined) {
+      const o = document.createElement("div");
+      o.className = "step-outcome";
+      o.textContent = v.outcome;
+      li.appendChild(o);
+    }
+    if (v.state !== undefined) {
+      const st = document.createElement("div");
+      st.className = "step-state";
+      st.textContent = v.state;
+      li.appendChild(st);
+      li.addEventListener("click", () => descend(i));
+    } else {
+      const st = document.createElement("div");
+      st.className = "step-outcome";
+      st.textContent = "action ignored (no-op)";
+      li.appendChild(st);
+    }
+    ol.appendChild(li);
+  });
+  $("current-state").textContent =
+    stateOfPath || "(pick an init state below)";
+}
+
+function descend(i) {
+  const v = currentViews[i];
+  if (!v || v.fingerprint === undefined) return;
+  location.hash = "#/steps/" + currentPath.concat([v.fingerprint]).join("/");
+}
+
+// ---------------------------------------------------------------- routing --
+function route() {
+  const h = location.hash;
+  const m = h.match(/^#\/steps\/?(.*)$/);
+  const parts = m && m[1] ? m[1].split("/").filter(Boolean) : [];
+  loadPath(parts);
+}
+
+// --------------------------------------------------------------- keyboard --
+document.addEventListener("keydown", (e) => {
+  if (e.key === "j" || e.key === "ArrowDown") {
+    selected = Math.min(selected + 1, currentViews.length - 1);
+    renderSteps();
+    e.preventDefault();
+  } else if (e.key === "k" || e.key === "ArrowUp") {
+    selected = Math.max(selected - 1, 0);
+    renderSteps();
+    e.preventDefault();
+  } else if (e.key === "Enter" && selected >= 0) {
+    descend(selected);
+  } else if (e.key === "Backspace") {
+    if (currentPath.length) {
+      location.hash = "#/steps/" + currentPath.slice(0, -1).join("/");
+    }
+    e.preventDefault();
+  }
+});
+
+window.addEventListener("hashchange", route);
+pollStatus();
+setInterval(pollStatus, 2000);
+route();

@@ -1,0 +1,110 @@
+"""Explorer endpoint tests (reference ``src/checker/explorer.rs:242-447``):
+exact JSON views against a live (background) server over small models."""
+
+import json
+import urllib.request
+
+import pytest
+
+from stateright_tpu.explorer import serve
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+from fixtures import LinearEquation
+
+
+@pytest.fixture(scope="module")
+def lineq_server():
+    server = serve(
+        LinearEquation(a=2, b=10, c=14).checker(),
+        "localhost:0",  # ephemeral port
+        block=False,
+    )
+    server.checker.join()
+    yield server
+    server.shutdown()
+
+
+def get(server, path):
+    with urllib.request.urlopen(f"http://{server.addr}{path}") as r:
+        return json.loads(r.read())
+
+
+def get_status(server, path):
+    try:
+        with urllib.request.urlopen(f"http://{server.addr}{path}") as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_status_endpoint(lineq_server):
+    s = get(lineq_server, "/.status")
+    assert s["done"] is True
+    assert s["model"] == "LinearEquation"
+    assert s["unique_state_count"] == 12  # reference checker.rs:459-461
+    assert s["state_count"] == 15
+    kinds = {name: kind for kind, name, _ in s["properties"]}
+    assert kinds == {"solvable": "sometimes"}
+    # the sometimes-property discovery is an encoded fingerprint path
+    discovery = dict(
+        (name, disc) for _, name, disc in s["properties"]
+    )["solvable"]
+    assert discovery is not None and "/" in discovery
+
+
+def test_init_states_view(lineq_server):
+    views = get(lineq_server, "/.states/")
+    assert len(views) == 1
+    assert views[0]["state"] == "(0, 0)"
+    assert "action" not in views[0]
+    assert int(views[0]["fingerprint"]) > 0
+
+
+def test_steps_view_follows_fingerprints(lineq_server):
+    init = get(lineq_server, "/.states/")[0]
+    steps = get(lineq_server, f"/.states/{init['fingerprint']}")
+    # format_action is repr(), like the reference's Debug formatting
+    assert {v["action"] for v in steps} == {"'IncreaseX'", "'IncreaseY'"}
+    for v in steps:
+        assert "state" in v and "fingerprint" in v
+    # walk one more level
+    nxt = steps[0]
+    steps2 = get(
+        lineq_server, f"/.states/{init['fingerprint']}/{nxt['fingerprint']}"
+    )
+    assert len(steps2) == 2
+
+
+def test_unknown_fingerprint_404(lineq_server):
+    code, body = get_status(lineq_server, "/.states/12345")
+    assert code == 404 and "Unable to find state" in body["error"]
+
+
+def test_unparseable_fingerprint_404(lineq_server):
+    code, body = get_status(lineq_server, "/.states/zzz")
+    assert code == 404 and "Unable to parse" in body["error"]
+
+
+def test_ui_is_served(lineq_server):
+    with urllib.request.urlopen(f"http://{lineq_server.addr}/") as r:
+        html = r.read().decode()
+    assert "State Space Explorer" in html
+    with urllib.request.urlopen(f"http://{lineq_server.addr}/app.js") as r:
+        assert "pollStatus" in r.read().decode()
+
+
+def test_discovery_path_resolves_through_states_endpoint():
+    server = serve(TwoPhaseSys(3).checker(), "localhost:0", block=False)
+    try:
+        server.checker.join()
+        s = get(server, "/.status")
+        disc = dict((n, d) for _, n, d in s["properties"])
+        fps = disc["commit agreement"].split("/")
+        # every prefix of the discovery path resolves
+        for i in range(len(fps)):
+            views = get(server, "/.states/" + "/".join(fps[: i + 1]))
+            assert isinstance(views, list)
+        # the recent-path snapshot was populated by the visitor
+        assert s["recent_path"] is None or s["recent_path"].startswith("[")
+    finally:
+        server.shutdown()
