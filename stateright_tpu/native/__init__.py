@@ -1,0 +1,50 @@
+"""Native (C++) host-side hot paths, with transparent pure-Python fallback.
+
+The reference implements its entire runtime natively; here the TPU engine
+subsumes the performance-critical checking loop, and the remaining host-side
+hot spot is the per-state consistency search on CPU execution paths
+(reference ``src/semantics/linearizability.rs:178-240``).  That search is
+implemented in C++ (``linearize.cpp``) and loaded lazily; if no compiled
+module is present we build it on first use with the toolchain in the image
+(setuptools + g++), and if that fails everything silently falls back to the
+Python implementation.
+
+Build artifacts live inside this directory (``_stateright_native*.so``);
+``python -m stateright_tpu.native.build`` forces a rebuild.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+from pathlib import Path
+from typing import Optional
+
+_DIR = Path(__file__).parent
+_module = None
+_attempted = False
+
+
+def load() -> Optional[object]:
+    """The native module, building it on first call if needed; None if
+    unavailable (no compiler, build error, ...)."""
+    global _module, _attempted
+    if _module is not None or _attempted:
+        return _module
+    _attempted = True
+    if str(_DIR) not in sys.path:
+        sys.path.insert(0, str(_DIR))
+    try:
+        _module = importlib.import_module("_stateright_native")
+        return _module
+    except ImportError:
+        pass
+    try:
+        from .build import build
+
+        build()
+        importlib.invalidate_caches()
+        _module = importlib.import_module("_stateright_native")
+    except Exception:
+        _module = None
+    return _module

@@ -1,0 +1,42 @@
+"""Build the native extension in-place (``python -m stateright_tpu.native.build``)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import sysconfig
+from pathlib import Path
+
+_DIR = Path(__file__).parent
+
+
+def build() -> Path:
+    """Compile linearize.cpp into ``_stateright_native`` next to it."""
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = _DIR / f"_stateright_native{ext}"
+    src = _DIR / "linearize.cpp"
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return out
+    include = sysconfig.get_path("include")
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        f"-I{include}",
+        str(src),
+        "-o",
+        str(out),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.path.insert(0, str(_DIR))
+    import _stateright_native  # noqa: F401  (smoke import)
+
+    print("import OK")

@@ -17,6 +17,8 @@ class SequentialConsistencyTester(LinearizabilityTester):
     """Shares recording with LinearizabilityTester; ``_last_completed``
     snapshots are recorded but ignored during serialization."""
 
+    _REAL_TIME = False  # native search drops the real-time prerequisites too
+
     def serialized_history(self) -> Optional[list]:
         if not self.valid:
             return None

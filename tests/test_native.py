@@ -1,0 +1,115 @@
+"""Native consistency-search parity: the C++ search must agree with the
+Python search on every history, linearizability and sequential consistency
+alike."""
+
+import random
+
+import pytest
+
+from stateright_tpu.native import load
+from stateright_tpu.semantics import (
+    LinearizabilityTester,
+    Register,
+    SequentialConsistencyTester,
+)
+from stateright_tpu.semantics.register import READ, write
+
+pytestmark = pytest.mark.skipif(
+    load() is None, reason="native module unavailable (no compiler?)"
+)
+
+
+def python_verdict(tester) -> bool:
+    return tester.valid and tester.serialized_history() is not None
+
+
+def native_verdict(tester) -> bool:
+    v = tester._native_verdict()
+    assert v is not None, "native path unexpectedly unavailable"
+    return v
+
+
+def random_histories(seed: int, n: int):
+    """Generate testers by simulating random register traffic."""
+    rng = random.Random(seed)
+    for _ in range(n):
+        for cls in (LinearizabilityTester, SequentialConsistencyTester):
+            t = cls(Register("\0"))
+            threads = list(range(rng.randint(1, 3)))
+            pending = {}
+            register = "\0"  # a "real" execution trace to bias toward valid
+            for _ in range(rng.randint(0, 8)):
+                th = rng.choice(threads)
+                if th in pending:
+                    op = pending.pop(th)
+                    if op[0] == "write":
+                        register = (
+                            op[1] if rng.random() < 0.8 else register
+                        )
+                        t = t.on_return(th, ("write_ok",))
+                    else:
+                        value = (
+                            register
+                            if rng.random() < 0.6
+                            else rng.choice("ABC\0")
+                        )
+                        t = t.on_return(th, ("read_ok", value))
+                else:
+                    if rng.random() < 0.5:
+                        op = write(rng.choice("ABC"))
+                    else:
+                        op = READ
+                    pending[th] = op
+                    t = t.on_invoke(th, op)
+            yield t
+
+
+def test_native_matches_python_on_random_histories():
+    mismatches = []
+    for i, tester in enumerate(random_histories(seed=42, n=400)):
+        py = python_verdict(tester)
+        nat = native_verdict(tester)
+        if py != nat:
+            mismatches.append((i, tester, py, nat))
+    assert not mismatches, mismatches[:3]
+
+
+def test_native_handles_known_cases():
+    # linearizable: W(A) completes, then read returns A
+    t = LinearizabilityTester(Register("\0"))
+    t = t.on_invoke(0, write("A")).on_return(0, ("write_ok",))
+    t = t.on_invoke(1, READ).on_return(1, ("read_ok", "A"))
+    assert native_verdict(t) and python_verdict(t)
+
+    # NOT linearizable: read of a value that was never written
+    t2 = LinearizabilityTester(Register("\0"))
+    t2 = t2.on_invoke(1, READ).on_return(1, ("read_ok", "Z"))
+    assert not native_verdict(t2) and not python_verdict(t2)
+
+    # stale read: linearizability rejects, sequential consistency accepts
+    def run(cls):
+        t = cls(Register("\0"))
+        t = t.on_invoke(0, write("A")).on_return(0, ("write_ok",))
+        t = t.on_invoke(1, READ).on_return(1, ("read_ok", "\0"))
+        return t
+
+    assert not native_verdict(run(LinearizabilityTester))
+    assert native_verdict(run(SequentialConsistencyTester))
+
+    # in-flight write may explain a read (never returned)
+    t3 = LinearizabilityTester(Register("\0"))
+    t3 = t3.on_invoke(0, write("A"))  # in flight forever
+    t3 = t3.on_invoke(1, READ).on_return(1, ("read_ok", "A"))
+    assert native_verdict(t3) and python_verdict(t3)
+
+    # protocol misuse invalidates permanently
+    t4 = LinearizabilityTester(Register("\0"))
+    t4 = t4.on_return(0, ("write_ok",))
+    assert not t4.valid and t4._native_verdict() is False
+
+
+def test_is_consistent_uses_native_and_caches():
+    t = LinearizabilityTester(Register("\0"))
+    t = t.on_invoke(0, write("A")).on_return(0, ("write_ok",))
+    assert t.is_consistent() is True
+    assert t.is_consistent() is True  # cached path
