@@ -123,6 +123,11 @@ class _Draft:
 # -- the model ---------------------------------------------------------------
 
 
+def _default_boundary(cfg, state) -> bool:
+    """Module-level so compilers can recognize a trivial boundary."""
+    return True
+
+
 class ActorModel(Model):
     """Builder + Model implementation (reference ``model.rs:27-155,187-494``)."""
 
@@ -135,42 +140,50 @@ class ActorModel(Model):
         self._properties: list[Property] = []
         self._record_msg_in: Callable = lambda cfg, h, env: None
         self._record_msg_out: Callable = lambda cfg, h, env: None
-        self._within_boundary: Callable = lambda cfg, state: True
+        self._within_boundary: Callable = _default_boundary
 
     # -- builder (reference ``model.rs:80-155``) -----------------------------
 
     def actor(self, actor: Actor) -> "ActorModel":
+        self._config_mutated()
         self.actors.append(actor)
         return self
 
     def actor_many(self, actors: Iterable[Actor]) -> "ActorModel":
+        self._config_mutated()
         self.actors.extend(actors)
         return self
 
     def init_network_(self, network: Network) -> "ActorModel":
+        self._config_mutated()
         self.init_network = network
         return self
 
     def lossy_network(self, lossy: bool) -> "ActorModel":
+        self._config_mutated()
         self.lossy = lossy
         return self
 
     def property(
         self, expectation: Expectation, name: str, condition: Callable
     ) -> "ActorModel":
+        self._config_mutated()
         self._properties.append(Property(expectation, name, condition))
         return self
 
     def record_msg_in(self, fn: Callable) -> "ActorModel":
         """``fn(cfg, history, envelope) -> Optional[new_history]``."""
+        self._config_mutated()
         self._record_msg_in = fn
         return self
 
     def record_msg_out(self, fn: Callable) -> "ActorModel":
+        self._config_mutated()
         self._record_msg_out = fn
         return self
 
     def within_boundary_(self, fn: Callable) -> "ActorModel":
+        self._config_mutated()
         self._within_boundary = fn
         return self
 

@@ -124,6 +124,11 @@ class Model(Generic[State, Action]):
 
     # -- identity ------------------------------------------------------------
 
+    def _config_mutated(self) -> None:
+        """Hook called by builder-style subclasses when configuration changes
+        after construction; tensor-backed models use it to invalidate cached
+        eligibility decisions."""
+
     def fingerprint_state(self, state: State) -> int:
         """Stable nonzero 64-bit state identity.  Tensor-form models override
         this with the device row hash of ``encode_state`` for bit-parity."""

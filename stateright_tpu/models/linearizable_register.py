@@ -28,6 +28,7 @@ from ..actor.register import (
     record_returns,
     value_chosen,
 )
+from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import default_threads, run_cli
 
@@ -177,13 +178,44 @@ class AbdServer(Actor):
         return None
 
 
+class AbdModel(TensorBackedModel, ActorModel):
+    """ActorModel with a mechanically compiled device twin
+    (``parallel/actor_compiler.py``): eligible configurations (unordered
+    non-duplicating network, ``put_count=1`` clients) run on the TPU
+    wavefront engine with no protocol-specific device code."""
+
+    def tensor_model(self):
+        from ..parallel.actor_compiler import CompileError, compile_actor_model
+
+        C = sum(isinstance(a, RegisterClient) for a in self.actors)
+
+        def state_bound(i, s):
+            # ABD sequencers are (logical clock, server id); each of the C
+            # writes bumps the clock by at most one, so clock <= C in any
+            # real run — the bound only cuts closure over-approximation.
+            return not isinstance(s, AbdState) or s.seq[0] <= C
+
+        def env_bound(env):
+            m = env.msg
+            if m[0] == "internal" and m[1][0] in ("ack_query", "record"):
+                return m[1][2][0] <= C
+            return True
+
+        try:
+            return compile_actor_model(
+                self, state_bound=state_bound, env_bound=env_bound
+            )
+        except (CompileError, ValueError):
+            return None
+
+
 def abd_model(
     client_count: int, server_count: int = 2, network: Optional[Network] = None
 ) -> ActorModel:
     """Build the checked system (reference ``linearizable-register.rs:195-230``)."""
     if network is None:
         network = Network.new_unordered_nonduplicating()
-    m = ActorModel(
+    m = AbdModel(
         cfg=None, init_history=LinearizabilityTester(Register(NULL_VALUE))
     )
     for i in range(server_count):

@@ -183,11 +183,15 @@ class PaxosServer(Actor):
 
 
 class PaxosModel(TensorBackedModel, ActorModel):
-    """ActorModel specialization carrying a tensor (device) twin for the
-    benchmark configuration — 3 servers, 1..3 clients doing one put each,
-    unordered non-duplicating lossless network (see ``paxos_tensor.py``).
-    Eligibility is derived from the live builder state; other configurations
-    fall back to structural fingerprints and CPU checking."""
+    """ActorModel specialization carrying a tensor (device) twin.
+
+    The benchmark configuration — 3 servers, 1..3 clients doing one put
+    each, unordered non-duplicating lossless network — uses the hand-tuned
+    twin (``paxos_tensor.py``).  Other configurations (4 clients, ≠3
+    servers) fall back to the mechanical compiler
+    (``parallel/actor_compiler.py``); configurations neither supports fall
+    back to structural fingerprints and CPU checking.  Eligibility is
+    derived from the live builder state."""
 
     def tensor_model(self):
         from ..actor.network import UnorderedNonDuplicatingNetwork
@@ -196,17 +200,41 @@ class PaxosModel(TensorBackedModel, ActorModel):
         servers = sum(isinstance(a, PaxosServer) for a in self.actors)
         clients = self.actors[servers:]
         if (
-            servers != 3
-            or not 1 <= len(clients) <= 3
-            or not all(
+            servers == 3
+            and 1 <= len(clients) <= 3
+            and all(
                 isinstance(a, RegisterClient) and a.put_count == 1
                 for a in clients
             )
-            or self.lossy
-            or not isinstance(self.init_network, UnorderedNonDuplicatingNetwork)
+            and not self.lossy
+            and isinstance(self.init_network, UnorderedNonDuplicatingNetwork)
         ):
+            return PaxosTensor(self, len(clients))
+        return self._compiled_tensor(len(clients))
+
+    def _compiled_tensor(self, client_count: int):
+        from ..parallel.actor_compiler import CompileError, compile_actor_model
+
+        C = client_count
+
+        def state_bound(i, s):
+            # Each of the C puts starts exactly one new ballot, so ballot
+            # rounds never exceed C in a real run; the bound only cuts the
+            # closure's over-approximation (SURVEY §7.3: bounded domains).
+            return not isinstance(s, PaxosState) or s.ballot[0] <= C
+
+        def env_bound(env):
+            m = env.msg
+            if m[0] == "internal":
+                return m[1][1][0] <= C
+            return True
+
+        try:
+            return compile_actor_model(
+                self, state_bound=state_bound, env_bound=env_bound
+            )
+        except (CompileError, ValueError):
             return None
-        return PaxosTensor(self, len(clients))
 
 
 def paxos_model(

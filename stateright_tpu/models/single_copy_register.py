@@ -24,6 +24,7 @@ from ..actor.register import (
     record_returns,
     value_chosen,
 )
+from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import default_threads, run_cli
 
@@ -46,12 +47,25 @@ class SingleCopyServer(Actor):
         return None
 
 
+class SingleCopyModel(TensorBackedModel, ActorModel):
+    """ActorModel with a mechanically compiled device twin; single-copy
+    server state is just the stored value, so no closure bounds are needed."""
+
+    def tensor_model(self):
+        from ..parallel.actor_compiler import CompileError, compile_actor_model
+
+        try:
+            return compile_actor_model(self)
+        except (CompileError, ValueError):
+            return None
+
+
 def single_copy_model(
     client_count: int, server_count: int = 1, network: Optional[Network] = None
 ) -> ActorModel:
     if network is None:
         network = Network.new_unordered_nonduplicating()
-    m = ActorModel(
+    m = SingleCopyModel(
         cfg=None, init_history=LinearizabilityTester(Register(NULL_VALUE))
     )
     for _ in range(server_count):

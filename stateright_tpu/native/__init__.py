@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import importlib
 import sys
+import warnings
 from pathlib import Path
 from typing import Optional
 
@@ -45,6 +46,16 @@ def load() -> Optional[object]:
         build()
         importlib.invalidate_caches()
         _module = importlib.import_module("_stateright_native")
-    except Exception:
+    except Exception as e:
+        # one-time diagnostic: a misconfigured toolchain would otherwise
+        # silently degrade consistency checking to the slower Python search
+        warnings.warn(
+            f"native extension build failed ({type(e).__name__}: {e}); "
+            "falling back to the pure-Python consistency search "
+            "(run `python -m stateright_tpu.native.build` to see the "
+            "full build log)",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         _module = None
     return _module

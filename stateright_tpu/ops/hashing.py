@@ -14,19 +14,26 @@ to the transition expansion, and entirely fusible.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
 from ..fingerprint import FINGERPRINT_SEED
 
-_GAMMA = jnp.uint64(0x9E3779B97F4A7C15)
-_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_M2 = jnp.uint64(0x94D049BB133111EB)
-_SEED = jnp.uint64(FINGERPRINT_SEED)
+# NumPy (not jnp) scalars: creating a jnp value at module import would
+# eagerly initialize the default JAX backend, which hangs every pure-host
+# code path (CPU checkers, fingerprinting) on hosts whose ambient platform
+# is a real accelerator plugin.  NumPy scalars promote identically inside
+# traced code.
+_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_SEED = np.uint64(FINGERPRINT_SEED)
 
 # Empty-slot sentinel for device hash tables.  Fingerprints are accepted to
 # collide at the 64-bit level (as in the reference); colliding with the
 # sentinel is the same class of risk.
-EMPTY = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+EMPTY = np.uint64(0xFFFFFFFFFFFFFFFF)
 
 
 def mix64(h: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,633 @@
+"""Mechanical actor-system → tensor-form compiler for register workloads.
+
+Round 1 proved actor systems can run on the wavefront engine with a
+hand-written 700-line device twin per protocol (``models/paxos_tensor.py``).
+This module makes that a *capability*: given any ``ActorModel`` following the
+standard register-workload shape (reference ``src/actor/register.rs`` — a set
+of protocol servers, ``RegisterClient(put_count=1)`` clients, a
+linearizability-tester history, an unordered non-duplicating network), it
+compiles the Python actor handlers into table-driven jittable ``step_rows``
+mechanically.  Reference transition semantics being compiled:
+``src/actor/model.rs:187-306``.
+
+How: a bounded host-side closure co-enumerates
+
+ - per-actor reachable state universes ``S_i`` (states become small integer
+   codes),
+ - the envelope universe ``E`` (envelopes become slot codes for the
+   sorted-slot multiset network of ``actor_tensor.py``), and
+ - the transition relation ``T_i[s, e] -> (s', sends…)`` by *running each
+   actor's real ``on_msg`` handler once per (state, envelope) pair* —
+   the handlers never run on device, only their tabulated effects do.
+
+The closure over-approximates reachability (it pairs every known state with
+every known envelope), which is what makes it cheap — but means protocols
+whose field domains grow with context (Paxos ballots, ABD sequencers) need a
+``state_bound`` predicate to cut the divergent tail.  Transitions that would
+leave the bound are marked *poison*; executing one on device sets a poison
+bit in the row, and parity tests guarantee bounded configurations never
+poison (the bound only cuts over-approximation, not real reachability).
+
+History (the linearizability tester) is not table-driven per transition —
+its joint state is factored into per-thread fields updated arithmetically on
+device, with the ``linearizable`` verdict precomputed per joint history
+state (:mod:`.history_tensor`).  The two standard register-workload
+properties are recognized by name: ``linearizable`` (ALWAYS, history
+verdict lookup) and ``value chosen`` (SOMETIMES, a non-null ``get_ok`` in
+flight — reference ``examples/paxos.rs:255-262``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..actor import Id, SetTimer, CancelTimer, Out, Send
+from ..actor.model import ActorModel, ActorModelState, _default_boundary
+from ..actor.network import Envelope, UnorderedNonDuplicatingNetwork
+from ..actor.register import NULL_VALUE, RegisterClient
+from ..semantics import LinearizabilityTester
+from .actor_tensor import (
+    COUNT_BITS,
+    COUNT_MASK,
+    SLOT_EMPTY,
+    SlotCodec,
+    slot_canonicalize,
+    slot_send,
+)
+from .history_tensor import (
+    PHASE_DONE,
+    PHASE_R_INFLIGHT,
+    PHASE_W_INFLIGHT,
+    LinHistoryCodec,
+)
+from .tensor_model import BitPacker, TensorModel
+
+#: envelope-kind codes for the history/property tables
+_K_OTHER, _K_PUT_OK, _K_GET_OK = 0, 1, 2
+
+
+class CompileError(Exception):
+    """The model is outside the compilable fragment."""
+
+
+def compile_actor_model(
+    model: ActorModel,
+    *,
+    state_bound: Optional[Callable] = None,
+    env_bound: Optional[Callable] = None,
+    n_slots: Optional[int] = None,
+    max_states_per_actor: int = 200_000,
+    max_envelopes: int = 100_000,
+    max_history_states: int = 2_000_000,
+) -> "CompiledActorTensor":
+    """Compile ``model`` to a :class:`TensorModel`; raises
+    :class:`CompileError` when the model is outside the supported fragment
+    (callers typically catch it and fall back to CPU checking).
+
+    ``state_bound(actor_index, state) -> bool`` /
+    ``env_bound(envelope) -> bool`` cut the closure's over-approximation for
+    protocols with context-dependent domains; transitions crossing the bound
+    poison the row on device rather than silently diverging.
+    """
+    return CompiledActorTensor(
+        model,
+        state_bound=state_bound,
+        env_bound=env_bound,
+        n_slots=n_slots,
+        max_states_per_actor=max_states_per_actor,
+        max_envelopes=max_envelopes,
+        max_history_states=max_history_states,
+    )
+
+
+class CompiledActorTensor(TensorModel):
+    """Table-driven device twin of a register-workload ``ActorModel``."""
+
+    def __init__(
+        self,
+        model: ActorModel,
+        *,
+        state_bound,
+        env_bound,
+        n_slots,
+        max_states_per_actor,
+        max_envelopes,
+        max_history_states,
+    ):
+        self.model = model
+        self._check_fragment()
+        self._state_bound = state_bound or (lambda i, s: True)
+        self._env_bound = env_bound or (lambda e: True)
+        self._caps = (max_states_per_actor, max_envelopes)
+
+        self.n_actors = len(model.actors)
+        self.clients = [
+            i
+            for i, a in enumerate(model.actors)
+            if isinstance(a, RegisterClient)
+        ]
+        self.C = len(self.clients)
+        values = [
+            chr(ord("A") + int(t) - model.actors[t].server_count)
+            for t in self.clients
+        ]
+        self.hist = LinHistoryCodec(
+            self.clients,
+            values,
+            NULL_VALUE,
+            tester_factory=lambda: type(model.init_history)(
+                model.init_history.init_ref_obj
+            ),
+            max_states=max_history_states,
+        )
+
+        self._closure()
+
+        self.n_slots = n_slots if n_slots is not None else max(
+            16, 4 * self.n_actors
+        )
+        self.max_actions = self.n_slots * (2 if model.lossy else 1)
+        fields = []
+        for i in range(self.n_actors):
+            bits = max(1, int(np.ceil(np.log2(max(2, len(self._states[i]))))))
+            fields.append((f"a{i}", bits))
+        for c in range(self.C):
+            fields += [
+                (f"h{c}_phase", 2),
+                (f"h{c}_snap", max(1, 2 * (self.C - 1))),
+                (f"h{c}_rval", 3),
+            ]
+        fields.append(("poison", 1))
+        self.pk = BitPacker(fields)
+        self.pw = self.pk.width
+        self.width = self.pw + self.n_slots
+        self.codec = SlotCodec(
+            self.n_slots,
+            lambda env: self._env_code[env],
+            lambda code: self._envs[code],
+        )
+        self._device_consts = None
+
+    # -- fragment check ------------------------------------------------------
+
+    def _check_fragment(self) -> None:
+        m = self.model
+        if not isinstance(m.init_network, UnorderedNonDuplicatingNetwork):
+            raise CompileError(
+                "only unordered non-duplicating networks are compilable"
+            )
+        if m._within_boundary is not _default_boundary:
+            raise CompileError("custom within_boundary is not compilable")
+        if not isinstance(m.init_history, LinearizabilityTester):
+            raise CompileError(
+                "history must be a LinearizabilityTester (register workload)"
+            )
+        names = sorted(p.name for p in m.properties())
+        if names != ["linearizable", "value chosen"]:
+            raise CompileError(
+                "compilable property set is exactly "
+                "{'linearizable', 'value chosen'}; got " + repr(names)
+            )
+        from ..actor.register import record_invocations, record_returns
+
+        if (
+            m._record_msg_in is not record_returns
+            or m._record_msg_out is not record_invocations
+        ):
+            # the device history update hard-codes these recorders' semantics
+            # (put_ok/get_ok -> returns, put/get sends -> invocations)
+            raise CompileError(
+                "history recorders must be the standard register "
+                "record_returns/record_invocations"
+            )
+        clients = [a for a in m.actors if isinstance(a, RegisterClient)]
+        if not clients or any(c.put_count != 1 for c in clients):
+            raise CompileError(
+                "workload must be RegisterClient actors with put_count=1"
+            )
+        if any(
+            isinstance(a, RegisterClient)
+            != (i >= len(m.actors) - len(clients))
+            for i, a in enumerate(m.actors)
+        ):
+            raise CompileError("clients must follow servers in the actor list")
+
+    # -- closure -------------------------------------------------------------
+
+    def _closure(self) -> None:
+        """Co-enumerate per-actor state universes, the envelope universe, and
+        the transition tables by running the real handlers host-side."""
+        m = self.model
+        n = self.n_actors
+        max_s, max_e = self._caps
+
+        self._states: list[list] = [[] for _ in range(n)]  # code -> state
+        self._state_code: list[dict] = [{} for _ in range(n)]
+        self._envs: list[Envelope] = []  # code -> envelope
+        self._env_code: dict[Envelope, int] = {}
+        # (i, s_code, e_code) -> (new_s_code | -1, sends tuple, poison)
+        trans: dict[tuple, tuple] = {}
+        work: deque = deque()  # ("s", i, s_code) | ("e", e_code)
+
+        def add_state(i: int, s) -> tuple[int, bool]:
+            code = self._state_code[i].get(s)
+            if code is not None:
+                return code, True
+            if not self._state_bound(i, s):
+                return -1, False
+            code = len(self._states[i])
+            if code >= max_s:
+                raise CompileError(
+                    f"actor {i} state universe exceeded {max_s}; "
+                    "tighten state_bound"
+                )
+            self._states[i].append(s)
+            self._state_code[i][s] = code
+            work.append(("s", i, code))
+            return code, True
+
+        def add_env(env: Envelope) -> tuple[int, bool]:
+            code = self._env_code.get(env)
+            if code is not None:
+                return code, True
+            if not self._env_bound(env):
+                return -1, False
+            code = len(self._envs)
+            if code >= max_e:
+                raise CompileError(
+                    f"envelope universe exceeded {max_e}; tighten env_bound"
+                )
+            self._envs.append(env)
+            self._env_code[env] = code
+            work.append(("e", code))
+            return code, True
+
+        # seed from the real initial system state
+        (init,) = m.init_states()
+        if any(init.is_timer_set):
+            # the encoding has no timer bits and step_rows generates no
+            # Timeout actions; compiling would silently drop that branch
+            raise CompileError("timers are not compilable")
+        self._init_state = init
+        for i, s in enumerate(init.actor_states):
+            code, ok = add_state(i, s)
+            if not ok:
+                raise CompileError(f"init state of actor {i} violates bound")
+        for env in init.network.iter_deliverable():
+            _, ok = add_env(env)
+            if not ok:
+                raise CompileError(f"init envelope {env!r} violates bound")
+
+        def process(i: int, s_code: int, e_code: int) -> None:
+            env = self._envs[e_code]
+            s = self._states[i][s_code]
+            out = Out()
+            try:
+                ret = m.actors[i].on_msg(Id(i), s, env.src, env.msg, out)
+            except CompileError:
+                raise
+            except Exception:
+                # The closure pairs every known state with every known
+                # envelope; protocol invariants can make some pairs
+                # impossible, and handlers may crash on them.  Treat the
+                # transition as poison: if it were actually reachable the
+                # object model would crash identically, and a device run
+                # that ever takes it produces a loudly-failing poisoned row
+                # instead of a silent divergence.
+                trans[(i, s_code, e_code)] = (s_code, (), True)
+                return
+            if any(
+                isinstance(c, (SetTimer, CancelTimer)) for c in out.commands
+            ):
+                raise CompileError("timers are not compilable")
+            if ret is None and not out.commands:
+                trans[(i, s_code, e_code)] = (-1, (), False)
+                return
+            new_s = s if ret is None else ret
+            poison = False
+            new_code, ok = add_state(i, new_s)
+            if not ok:
+                # Bound-crossing successor: keep the transition VALID as a
+                # poisoned self-loop so a too-tight state_bound surfaces as a
+                # loudly-failing poisoned row on device, never as a silently
+                # pruned reachable transition.
+                new_code, poison = s_code, True
+            sends = []
+            for c in out.commands:
+                assert isinstance(c, Send)
+                snd = Envelope(src=Id(i), dst=c.dst, msg=c.msg)
+                if snd.msg[0] == "put":
+                    raise CompileError(
+                        "mid-run put invocations are not compilable "
+                        "(put_count must be 1)"
+                    )
+                sc, ok = add_env(snd)
+                poison |= not ok
+                sends.append(sc)
+            trans[(i, s_code, e_code)] = (new_code, tuple(sends), poison)
+
+        while work:
+            item = work.popleft()
+            if item[0] == "s":
+                _, i, s_code = item
+                for e_code, env in enumerate(self._envs):
+                    if int(env.dst) == i:
+                        process(i, s_code, e_code)
+            else:
+                _, e_code = item
+                i = int(self._envs[e_code].dst)
+                if i < n:
+                    for s_code in range(len(self._states[i])):
+                        process(i, s_code, e_code)
+
+        # -- freeze tables ---------------------------------------------------
+        ne = len(self._envs)
+        self.K = max(
+            (len(snds) for (_, snds, _) in trans.values()), default=0
+        )
+        self._trans_np = []
+        self._sends_np = []
+        self._poison_np = []
+        for i in range(n):
+            ns = len(self._states[i])
+            ti = np.full((ns, ne), -1, np.int32)
+            pi = np.zeros((ns, ne), bool)
+            ki = np.full((ns, ne, max(self.K, 1)), -1, np.int32)
+            for (ai, sc, ec), (nc, snds, poison) in trans.items():
+                if ai != i:
+                    continue
+                ti[sc, ec] = nc
+                pi[sc, ec] = poison
+                for k, s in enumerate(snds):
+                    ki[sc, ec, k] = s
+            self._trans_np.append(ti)
+            self._sends_np.append(ki)
+            self._poison_np.append(pi)
+
+        # per-envelope metadata
+        self._env_dst = np.asarray(
+            [int(e.dst) for e in self._envs], np.int32
+        )
+        kinds = np.full(ne, _K_OTHER, np.int32)
+        vals = np.zeros(ne, np.int32)
+        chosen = np.zeros(ne, bool)
+        for c, e in enumerate(self._envs):
+            if e.msg[0] == "put_ok":
+                kinds[c] = _K_PUT_OK
+            elif e.msg[0] == "get_ok":
+                kinds[c] = _K_GET_OK
+                vals[c] = self.hist._value_code(e.msg[2])
+                chosen[c] = e.msg[2] != NULL_VALUE
+        self._env_kind = kinds
+        self._env_val = vals
+        self._env_chosen = chosen
+        self._client_of = np.asarray(
+            [
+                self.clients.index(i) if i in self.clients else -1
+                for i in range(n)
+            ],
+            np.int32,
+        )
+
+    # -- host bridge ---------------------------------------------------------
+
+    def encode_state(self, st: ActorModelState) -> tuple:
+        vals: dict[str, int] = {}
+        for i, s in enumerate(st.actor_states):
+            code = self._state_code[i].get(s)
+            if code is None:
+                raise RuntimeError(
+                    f"actor {i} state {s!r} is outside the compiled universe "
+                    "(state_bound too tight, or a closure gap)"
+                )
+            vals[f"a{i}"] = code
+        for c, (phase, snap, rval) in enumerate(
+            self.hist.fields_of_tester(st.history)
+        ):
+            vals[f"h{c}_phase"] = phase
+            vals[f"h{c}_snap"] = snap
+            vals[f"h{c}_rval"] = rval
+        vals["poison"] = 0
+        return self.pk.pack(**vals) + self.codec.pack(
+            st.network._counts.items()
+        )
+
+    def decode_state(self, row) -> ActorModelState:
+        d = self.pk.unpack(row[: self.pw])
+        if d["poison"]:
+            raise RuntimeError(
+                "poisoned row: a transition crossed the compile-time bound "
+                "(state_bound/env_bound too tight for this configuration)"
+            )
+        actors = tuple(
+            self._states[i][d[f"a{i}"]] for i in range(self.n_actors)
+        )
+        tester = self.hist.tester_of_fields(
+            [
+                (d[f"h{c}_phase"], d[f"h{c}_snap"], d[f"h{c}_rval"])
+                for c in range(self.C)
+            ]
+        )
+        network = UnorderedNonDuplicatingNetwork(
+            dict(self.codec.unpack(row[self.pw :]))
+        )
+        return ActorModelState(
+            actor_states=actors,
+            network=network,
+            is_timer_set=(False,) * self.n_actors,
+            history=tester,
+        )
+
+    def init_rows(self) -> np.ndarray:
+        return np.asarray([self.encode_state(self._init_state)], np.uint64)
+
+    # -- device --------------------------------------------------------------
+
+    def _consts(self):
+        import jax.numpy as jnp
+
+        if self._device_consts is None:
+            self._device_consts = {
+                "trans": [jnp.asarray(t) for t in self._trans_np],
+                "sends": [jnp.asarray(t) for t in self._sends_np],
+                "poison": [jnp.asarray(t) for t in self._poison_np],
+                "env_dst": jnp.asarray(self._env_dst),
+                "env_kind": jnp.asarray(self._env_kind),
+                "env_val": jnp.asarray(self._env_val),
+                "env_chosen": jnp.asarray(self._env_chosen),
+            }
+        return self._device_consts
+
+    def step_rows(self, rows):
+        import jax.numpy as jnp
+
+        cst = self._consts()
+        i32, u64 = jnp.int32, jnp.uint64
+        B = rows.shape[0]
+        NS, A, W = self.n_slots, self.max_actions, self.width
+        ne = len(self._envs)
+        pk = self.pk
+
+        slots = rows[:, self.pw :]  # [B, NS]
+        occupied = slots != u64(SLOT_EMPTY)
+        ecode = jnp.where(
+            occupied, (slots >> u64(COUNT_BITS)).astype(i32), 0
+        )  # [B, NS]
+        dst = cst["env_dst"][ecode]  # [B, NS]
+
+        # -- deliver actions (slot a delivers envelope in slot a) -----------
+        new_scode = jnp.zeros((B, NS), i32)
+        valid = jnp.zeros((B, NS), bool)
+        poison = jnp.zeros((B, NS), bool)
+        send_codes = jnp.full((B, NS, max(self.K, 1)), -1, i32)
+        for i in range(self.n_actors):
+            mask = occupied & (dst == i)
+            sc = pk.get(rows, f"a{i}").astype(i32)[:, None]  # [B, 1]
+            flat = sc * ne + ecode  # [B, NS]
+            nc = cst["trans"][i].reshape(-1)[flat]
+            pi = cst["poison"][i].reshape(-1)[flat]
+            ks = cst["sends"][i].reshape(-1, max(self.K, 1))[flat]
+            new_scode = jnp.where(mask, nc, new_scode)
+            valid = valid | (mask & (nc >= 0))
+            poison = poison | (mask & pi)
+            send_codes = jnp.where(mask[..., None], ks, send_codes)
+
+        # -- successor slot arrays ------------------------------------------
+        slots_b = jnp.broadcast_to(slots[:, None, :], (B, NS, NS))
+        diag = jnp.eye(NS, dtype=bool)[None]
+        count = (slots & u64(COUNT_MASK)).astype(i32)
+        delivered = jnp.where(
+            count <= 1, u64(SLOT_EMPTY), slots - u64(1)
+        )  # [B, NS]
+        slots_d = jnp.where(diag, delivered[:, :, None], slots_b)
+        for k in range(self.K):
+            sk = send_codes[..., k]
+            slots_d, of = slot_send(
+                slots_d, sk.astype(u64), valid & (sk >= 0)
+            )
+            poison = poison | of
+        slots_d = slot_canonicalize(slots_d)
+
+        # -- successor packed words -----------------------------------------
+        out = jnp.broadcast_to(rows[:, None, :], (B, NS, W))
+        for i in range(self.n_actors):
+            cur = pk.get(rows, f"a{i}").astype(i32)[:, None]
+            v = jnp.where(
+                valid & occupied & (dst == i), new_scode, cur
+            )
+            out = pk.set(out, f"a{i}", v.astype(u64))
+
+        # -- history updates -------------------------------------------------
+        if self.C:
+            kind = cst["env_kind"][ecode]  # [B, NS]
+            ci = self._client_of_dev()[jnp.clip(dst, 0, self.n_actors - 1)]
+            is_ret_w = valid & (kind == _K_PUT_OK) & (ci >= 0)
+            is_ret_r = valid & (kind == _K_GET_OK) & (ci >= 0)
+            rv = cst["env_val"][ecode]
+            phases = jnp.stack(
+                [
+                    pk.get(rows, f"h{c}_phase").astype(i32)
+                    for c in range(self.C)
+                ],
+                -1,
+            )  # [B, C]
+            # completed-op count per thread, derived from its phase
+            comp = jnp.where(
+                phases == PHASE_W_INFLIGHT,
+                0,
+                jnp.where(phases == PHASE_DONE, 2, 1),
+            )  # [B, C]
+            for c in range(self.C):
+                m_w = is_ret_w & (ci == c)  # write returned + read invoked
+                m_r = is_ret_r & (ci == c)
+                cur_ph = pk.get(rows, f"h{c}_phase").astype(i32)[:, None]
+                new_ph = jnp.where(
+                    m_w,
+                    PHASE_R_INFLIGHT,
+                    jnp.where(m_r, PHASE_DONE, cur_ph),
+                )
+                out = pk.set(out, f"h{c}_phase", new_ph.astype(u64))
+                # read-invocation snapshot: other threads' completed counts
+                if self.C > 1:
+                    snap = jnp.zeros((B, NS), i32)
+                    for j in range(self.C):
+                        if j == c:
+                            continue
+                        slot = self.hist._snap_slot(c, j)
+                        snap = snap | (comp[:, j : j + 1] << (2 * slot))
+                    cur_snap = pk.get(rows, f"h{c}_snap").astype(i32)[:, None]
+                    out = pk.set(
+                        out,
+                        f"h{c}_snap",
+                        jnp.where(m_w, snap, cur_snap).astype(u64),
+                    )
+                cur_rv = pk.get(rows, f"h{c}_rval").astype(i32)[:, None]
+                out = pk.set(
+                    out,
+                    f"h{c}_rval",
+                    jnp.where(m_r, rv, cur_rv).astype(u64),
+                )
+
+        cur_poison = pk.get(rows, "poison").astype(i32)[:, None]
+        out = pk.set(
+            out,
+            "poison",
+            jnp.maximum(jnp.where(poison, 1, 0), cur_poison).astype(u64),
+        )
+        succ = jnp.concatenate([out[:, :, : self.pw], slots_d], axis=-1)
+
+        if not self.model.lossy:
+            return succ, valid
+
+        # -- drop actions (lossy networks): consume without delivering ------
+        slots_drop = jnp.where(diag, delivered[:, :, None], slots_b)
+        drop_rows = jnp.concatenate(
+            [
+                jnp.broadcast_to(rows[:, None, : self.pw], (B, NS, self.pw)),
+                slot_canonicalize(slots_drop),
+            ],
+            axis=-1,
+        )
+        succ = jnp.concatenate([succ, drop_rows], axis=1)
+        valid = jnp.concatenate([valid, occupied], axis=1)
+        return succ, valid
+
+    def _client_of_dev(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self._client_of)
+
+    def property_masks(self, rows):
+        import jax.numpy as jnp
+
+        cst = self._consts()
+        i32, u64 = jnp.int32, jnp.uint64
+        pk = self.pk
+
+        phases = jnp.stack(
+            [pk.get(rows, f"h{c}_phase").astype(i32) for c in range(self.C)],
+            -1,
+        )
+        snaps = jnp.stack(
+            [pk.get(rows, f"h{c}_snap").astype(i32) for c in range(self.C)],
+            -1,
+        )
+        rvals = jnp.stack(
+            [pk.get(rows, f"h{c}_rval").astype(i32) for c in range(self.C)],
+            -1,
+        )
+        keys = self.hist.device_key(phases, snaps, rvals)
+        linearizable = self.hist.device_lookup(keys)
+
+        slots = rows[:, self.pw :]
+        occ = slots != u64(SLOT_EMPTY)
+        ecode = jnp.where(occ, (slots >> u64(COUNT_BITS)).astype(i32), 0)
+        chosen = jnp.any(occ & cst["env_chosen"][ecode], axis=-1)
+
+        masks = {"linearizable": linearizable, "value chosen": chosen}
+        return jnp.stack(
+            [masks[p.name] for p in self.model.properties()], axis=-1
+        )

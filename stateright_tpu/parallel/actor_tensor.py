@@ -106,12 +106,20 @@ def slot_send(slots, code, enable):
     Existing code -> count+1; else claim the first free slot (one-hot
     scatter, so repeated sends compose without re-sorting in between; the
     caller canonicalizes once per step).  Returns (slots, overflow):
-    ``overflow`` is True where enable is set but no slot was available.
+    ``overflow`` is True where enable is set but no slot was available, or
+    the matched slot's count field is saturated (a count+1 there would carry
+    into the envelope-code bits and silently corrupt the row — the device
+    analogue of ``SlotCodec.pack``'s count range check).
     """
     n = slots.shape[-1]
     match = slot_occupied(slots) & (slot_codes(slots) == code[..., None])
     exists = jnp.any(match, axis=-1)
-    bumped = jnp.where(match & enable[..., None], slots + jnp.uint64(1), slots)
+    maxed = jnp.any(
+        match & (slot_counts(slots) == jnp.uint64(COUNT_MASK)), axis=-1
+    )
+    bumped = jnp.where(
+        match & (enable & ~maxed)[..., None], slots + jnp.uint64(1), slots
+    )
 
     free = ~slot_occupied(slots)
     first_free = jnp.argmax(free, axis=-1)  # 0 if none free; gated below
@@ -122,7 +130,7 @@ def slot_send(slots, code, enable):
     ) & claim[..., None]
     neww = (code << jnp.uint64(COUNT_BITS)) | jnp.uint64(1)
     claimed = jnp.where(onehot, neww[..., None], bumped)
-    overflow = enable & ~exists & ~any_free
+    overflow = enable & ((~exists & ~any_free) | maxed)
     return claimed, overflow
 
 

@@ -1,0 +1,268 @@
+"""Device form of the linearizability-tester history for register workloads.
+
+The reference evaluates its ``linearizable`` property by running an
+exponential interleaving search per state (reference
+``src/semantics/linearizability.rs:178-240``).  The round-1 device twin
+replaced that with a ``(2C)!`` permutation table, which combinatorially caps
+out at 3 clients.  This codec scales further by exploiting that the joint
+tester state for the standard register workload (``RegisterClient`` with
+``put_count=1``: one write then one read per client) is *small and
+enumerable*:
+
+ 1. Host-side, enumerate every joint tester state reachable under ANY
+    interleaving of invoke/return events (a superset of what the protocol
+    can produce — extra entries are merely unused), via BFS over the real
+    :class:`~stateright_tpu.semantics.LinearizabilityTester` object.
+ 2. Evaluate the exact ``is_consistent()`` verdict for each enumerated
+    state once, at compile time (memoized, C++ fast path), instead of per
+    product-state at check time.
+ 3. Pack each joint state into a ≤63-bit integer key (per-thread phase /
+    read-invocation snapshot / read return value — the same fields the
+    tester itself depends on) and ship ``(sorted keys, verdicts)`` to the
+    device; the per-state property evaluation becomes a vectorized binary
+    search + gather.
+
+Per-thread fields (2 + 2·(C−1) + 3 bits):
+
+ - ``phase``: 0 = write in flight, 1 = read in flight, 2 = read returned,
+   3 = write returned / read not yet invoked.  Phase 3 never occurs in a
+   *stored* model state (the client invokes its read in the same transition
+   that returns its write) but appears as an intermediate in the event BFS.
+ - ``snap``: the read-invocation snapshot — for each other thread, the
+   number of operations it had completed (0..2), 2 bits each; the tester's
+   real-time constraint (``linearizability.rs:102-125``).
+ - ``rval``: index of the value the read returned (0 = the register's
+   initial/null value, 1.. = client values), once phase = 2.
+
+The key width caps supported client counts at 4 (2+2·3+3 = 11 bits × 4
+threads = 44-bit keys); beyond that the joint enumeration also becomes the
+bottleneck.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from ..semantics import LinearizabilityTester
+from ..semantics.register import READ, Register, write
+
+PHASE_W_INFLIGHT = 0
+PHASE_R_INFLIGHT = 1
+PHASE_DONE = 2
+PHASE_W_DONE = 3
+
+MAX_THREADS = 4
+
+
+class LinHistoryCodec:
+    """Host+device codec for the joint linearizability-tester state of a
+    ``put_count=1`` register workload."""
+
+    def __init__(
+        self,
+        threads: list,
+        values: list,
+        null_value,
+        tester_factory=None,
+        max_states: int = 2_000_000,
+    ):
+        if len(threads) > MAX_THREADS:
+            raise ValueError(
+                f"at most {MAX_THREADS} client threads supported "
+                f"(got {len(threads)})"
+            )
+        self.threads = [int(t) for t in threads]
+        self.values = list(values)  # values[i] is thread i's written value
+        self.null_value = null_value
+        self.C = C = len(threads)
+        self.phase_bits = 2
+        self.snap_bits = 2 * (C - 1)
+        self.rval_bits = 3
+        self.thread_bits = self.phase_bits + self.snap_bits + self.rval_bits
+        if tester_factory is None:
+            tester_factory = lambda: LinearizabilityTester(Register(null_value))
+        self._tester_factory = tester_factory
+        self._enumerate(max_states)
+
+    # -- field packing (host ints; the device mirrors this) ------------------
+
+    def pack_thread(self, phase: int, snap: int, rval: int) -> int:
+        return (
+            phase
+            | (snap << self.phase_bits)
+            | (rval << (self.phase_bits + self.snap_bits))
+        )
+
+    def key_of_fields(self, fields: list) -> int:
+        """``fields[i] = (phase, snap, rval)`` per thread -> packed key."""
+        key = 0
+        for i, (phase, snap, rval) in enumerate(fields):
+            key |= self.pack_thread(phase, snap, rval) << (i * self.thread_bits)
+        return key
+
+    # -- tester <-> fields ---------------------------------------------------
+
+    def fields_of_tester(self, tester: LinearizabilityTester) -> list:
+        """Per-thread (phase, snap, rval) of a tester state.  Raises if the
+        tester is not a state this workload can produce."""
+        if not tester.valid:
+            raise ValueError("invalid (protocol-misuse) tester state")
+        fields = []
+        for i, t in enumerate(self.threads):
+            completed = tester.history_by_thread.get(t, ())
+            in_flight = tester.in_flight_by_thread.get(t)
+            w_expect = write(self.values[i])
+            snap_src = None
+            rval = 0
+            if len(completed) == 0:
+                if in_flight is None or in_flight[1] != w_expect:
+                    raise ValueError(f"thread {t}: expected write in flight")
+                phase = PHASE_W_INFLIGHT
+            else:
+                if completed[0][1] != w_expect or completed[0][2] != (
+                    "write_ok",
+                ):
+                    raise ValueError(f"thread {t}: unexpected first op")
+                if len(completed) == 2:
+                    snap_src, op, ret = completed[1]
+                    if op != READ or ret[0] != "read_ok":
+                        raise ValueError(f"thread {t}: unexpected second op")
+                    rval = self._value_code(ret[1])
+                    phase = PHASE_DONE
+                elif in_flight is not None:
+                    snap_src, op = in_flight
+                    if op != READ:
+                        raise ValueError(f"thread {t}: unexpected in-flight op")
+                    phase = PHASE_R_INFLIGHT
+                else:
+                    phase = PHASE_W_DONE
+            snap = 0
+            if snap_src is not None:
+                for peer, idx in snap_src:
+                    j = self._thread_index(peer)
+                    snap |= (idx + 1) << (2 * self._snap_slot(i, j))
+            fields.append((phase, snap, rval))
+        return fields
+
+    def tester_of_fields(self, fields: list) -> LinearizabilityTester:
+        history: dict = {}
+        in_flight: dict = {}
+        for i, (phase, snap, rval) in enumerate(fields):
+            t = self.threads[i]
+            w_complete = ((), write(self.values[i]), ("write_ok",))
+            snap_t = tuple(
+                sorted(
+                    (self.threads[j], ((snap >> (2 * self._snap_slot(i, j))) & 3) - 1)
+                    for j in range(self.C)
+                    if j != i and (snap >> (2 * self._snap_slot(i, j))) & 3
+                )
+            )
+            if phase == PHASE_W_INFLIGHT:
+                history[t] = ()
+                in_flight[t] = ((), write(self.values[i]))
+            elif phase == PHASE_W_DONE:
+                history[t] = (w_complete,)
+            elif phase == PHASE_R_INFLIGHT:
+                history[t] = (w_complete,)
+                in_flight[t] = (snap_t, READ)
+            else:
+                history[t] = (
+                    w_complete,
+                    (snap_t, READ, ("read_ok", self._value_decode(rval))),
+                )
+        tester = self._tester_factory()
+        return type(tester)(
+            tester.init_ref_obj, history, in_flight, valid=True
+        )
+
+    def _thread_index(self, t) -> int:
+        return self.threads.index(int(t))
+
+    def _snap_slot(self, i: int, j: int) -> int:
+        """Bit-slot of peer ``j`` inside thread ``i``'s snapshot field
+        (peers are numbered skipping ``i`` itself)."""
+        return j if j < i else j - 1
+
+    def _value_code(self, v) -> int:
+        return 0 if v == self.null_value else self.values.index(v) + 1
+
+    def _value_decode(self, code: int):
+        return self.null_value if code == 0 else self.values[code - 1]
+
+    # -- enumeration ---------------------------------------------------------
+
+    def _enumerate(self, max_states: int) -> None:
+        """BFS over invoke/return events; superset of protocol-reachable
+        joint tester states."""
+        init = self._tester_factory()
+        for i, t in enumerate(self.threads):
+            init = init.on_invoke(t, write(self.values[i]))
+        seen = {init}
+        queue = deque([init])
+        read_rets = [("read_ok", self.null_value)] + [
+            ("read_ok", v) for v in self.values
+        ]
+        while queue:
+            tester = queue.popleft()
+            if len(seen) > max_states:
+                raise RuntimeError(
+                    f"joint tester enumeration exceeded {max_states} states"
+                )
+            for t in self.threads:
+                in_flight = tester.in_flight_by_thread.get(t)
+                completed = tester.history_by_thread.get(t, ())
+                if in_flight is not None:
+                    op = in_flight[1]
+                    if op == READ:
+                        succs = [tester.on_return(t, r) for r in read_rets]
+                    else:
+                        succs = [tester.on_return(t, ("write_ok",))]
+                elif len(completed) == 1:
+                    succs = [tester.on_invoke(t, READ)]
+                else:
+                    continue
+                for s in succs:
+                    if s not in seen:
+                        seen.add(s)
+                        queue.append(s)
+
+        keys = np.empty(len(seen), np.int64)
+        oks = np.empty(len(seen), bool)
+        for n, tester in enumerate(seen):
+            keys[n] = self.key_of_fields(self.fields_of_tester(tester))
+            oks[n] = tester.is_consistent()
+        order = np.argsort(keys)
+        self.table_keys = keys[order]
+        self.table_ok = oks[order]
+
+    # -- device --------------------------------------------------------------
+
+    def device_key(self, phases, snaps, rvals):
+        """Pack per-thread field arrays (each ``[..., C]`` int32) into keys
+        (int64), mirroring :meth:`key_of_fields`."""
+        import jax.numpy as jnp
+
+        key = jnp.zeros(phases.shape[:-1], jnp.int64)
+        for i in range(self.C):
+            word = (
+                phases[..., i]
+                | (snaps[..., i] << self.phase_bits)
+                | (rvals[..., i] << (self.phase_bits + self.snap_bits))
+            )
+            key = key | (word.astype(jnp.int64) << (i * self.thread_bits))
+        return key
+
+    def device_lookup(self, keys):
+        """Vectorized verdict lookup: binary search over the sorted key
+        table.  Keys absent from the table (combinations no interleaving can
+        produce) return False."""
+        import jax.numpy as jnp
+
+        tk = jnp.asarray(self.table_keys)
+        ok = jnp.asarray(self.table_ok)
+        idx = jnp.clip(
+            jnp.searchsorted(tk, keys, side="left"), 0, tk.shape[0] - 1
+        )
+        return ok[idx] & (tk[idx] == keys)

@@ -77,9 +77,10 @@ class TensorBackedModel:
 
     ``tensor_model()`` may return None for configurations without a device
     twin (e.g. an unsupported network semantics); fingerprints then fall back
-    to the base model's structural hash.  The verdict is cached on first use,
-    so eligibility is frozen once checking starts — configure the model fully
-    before fingerprinting.
+    to the base model's structural hash.  The verdict (and hence the
+    fingerprint scheme) is cached on first fingerprint; configuration
+    mutations after that point would silently mix fingerprint schemes, so
+    they raise instead (builder methods report via ``_config_mutated``).
     """
 
     _TENSOR_UNRESOLVED = "unresolved"
@@ -93,11 +94,24 @@ class TensorBackedModel:
             return super().fingerprint_state(state)
         return hash_words(tm.encode_state(state))
 
+    def _config_mutated(self) -> None:
+        if getattr(self, "_tensor_fp_used", False):
+            raise RuntimeError(
+                "model configuration changed after states were fingerprinted; "
+                "the tensor-twin eligibility (and fingerprint scheme) is "
+                "frozen at first use — configure the model fully before "
+                "checking or fingerprinting"
+            )
+        # not fingerprinted yet: safe to re-derive eligibility later
+        if hasattr(self, "_tensor_model_cache"):
+            object.__delattr__(self, "_tensor_model_cache")
+
     def _tensor_cached(self) -> Optional[TensorModel]:
         tm = getattr(self, "_tensor_model_cache", self._TENSOR_UNRESOLVED)
         if tm is self._TENSOR_UNRESOLVED:
             tm = self.tensor_model()
             object.__setattr__(self, "_tensor_model_cache", tm)
+        object.__setattr__(self, "_tensor_fp_used", True)
         return tm
 
 
