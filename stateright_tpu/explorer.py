@@ -64,18 +64,39 @@ _EXPECTATION_NAME = {
 }
 
 
+_ENCODED_CACHE: dict = {}  # (id(checker), name, final fp) -> encoded path
+
+
 def _status_view(model, checker, snapshot: _Snapshot) -> dict:
-    # discoveries() joins the check, so only read once done; discovery links
-    # appear in the UI when the run finishes
-    discoveries = checker.discoveries() if checker.is_done() else {}
+    # Discoveries are read live, while the check is still running, as in the
+    # reference (``explorer.rs:133-157`` reads the live discovery map):
+    # BfsChecker's discovery map and parent pointers are safely readable
+    # mid-run, so counterexample links appear in the UI as soon as found.
+    # Encoded paths are cached per discovery fingerprint — reconstruction
+    # re-executes the model along the whole trace, and the UI polls /.status
+    # continuously.
+    raw = getattr(checker, "_discoveries", None)
+    if raw is not None:
+        encoded = {}
+        for name, fp in dict(raw).items():
+            key = (id(checker), name, fp)
+            if key not in _ENCODED_CACHE:
+                _ENCODED_CACHE[key] = Path.from_fingerprints(
+                    model, checker._trace(fp)
+                ).encode(model)
+            encoded[name] = _ENCODED_CACHE[key]
+    else:  # other strategies: full (joining) reconstruction
+        encoded = {
+            name: path.encode(model)
+            for name, path in checker.discoveries().items()
+        }
     props = []
     for prop in model.properties():
-        path = discoveries.get(prop.name)
         props.append(
             [
                 _EXPECTATION_NAME[prop.expectation],
                 prop.name,
-                path.encode(model) if path is not None else None,
+                encoded.get(prop.name),
             ]
         )
     return {

@@ -282,6 +282,11 @@ class CompiledActorTensor(TensorModel):
                 raise CompileError(f"init envelope {env!r} violates bound")
 
         def process(i: int, s_code: int, e_code: int) -> None:
+            if (i, s_code, e_code) in trans:
+                # Every pair is queued from both sides (new-state x known
+                # envelopes and new-envelope x known states); run the real
+                # handler only once.
+                return
             env = self._envs[e_code]
             s = self._states[i][s_code]
             out = Out()
