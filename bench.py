@@ -139,11 +139,11 @@ def tpu_phase() -> dict:
     budget = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
     out: dict = {}
 
-    # parity gates on device
+    # parity gates on device (capacities sized so no growth event interrupts)
     tpu_p2 = with_tpu_retry(
-        lambda: paxos_model(2).checker().spawn_tpu(sync=True, capacity=1 << 16)
+        lambda: paxos_model(2).checker().spawn_tpu(sync=True, capacity=1 << 18)
     )
-    tpu_t5 = TwoPhaseSys(5).checker().spawn_tpu(sync=True, capacity=1 << 15)
+    tpu_t5 = TwoPhaseSys(5).checker().spawn_tpu(sync=True, capacity=1 << 17)
     if tpu_p2.unique_state_count() != PAXOS2_UNIQUE:
         raise AssertionError(
             f"tpu paxos2 unique {tpu_p2.unique_state_count()} != {PAXOS2_UNIQUE}"
@@ -159,7 +159,7 @@ def tpu_phase() -> dict:
     # so the compiled-run cache on the tensor twin is reused)
     target = os.environ.get("BENCH_TPU_TARGET", "500000")
     m3 = paxos_model(3)
-    caps = dict(capacity=1 << 22, frontier_capacity=1 << 16)
+    caps = dict(capacity=1 << 23, queue_capacity=1 << 21, batch=2048)
 
     def spawn3():
         b = m3.checker()
@@ -184,7 +184,7 @@ def tpu_phase() -> dict:
         if time.monotonic() - t_start > 0.6 * budget:
             raise TimeoutError("phase budget mostly spent; skipping 2pc7")
         t7 = TwoPhaseSys(7)
-        caps7 = dict(capacity=1 << 21, frontier_capacity=1 << 15)
+        caps7 = dict(capacity=1 << 21, queue_capacity=1 << 19, batch=2048)
         t7.checker().spawn_tpu(sync=True, **caps7)  # warm-up
         tpu_t7, dt7 = timed(lambda: t7.checker().spawn_tpu(sync=True, **caps7))
         out["tpu_2pc7_states_per_sec"] = round(tpu_t7.state_count() / dt7, 1)

@@ -60,11 +60,18 @@ class WavefrontChecker(Checker):
         self._parent_map: Optional[dict[int, int]] = None
         self._done = threading.Event()
         self._thread = None
+        # Fail fast on caller errors (e.g. a resume snapshot from a different
+        # model) in the caller's thread: raised inside the daemon worker they
+        # would only hit stderr and leave the checker silently never-done.
+        self._pre_run_validate()
         if sync:
             self._run()
         else:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
+
+    def _pre_run_validate(self) -> None:  # engine-specific, optional
+        pass
 
     def _verify_fingerprint_bridge(self):
         """Host fingerprint must equal the device row hash, else traces cannot
@@ -104,10 +111,16 @@ class WavefrontChecker(Checker):
     def max_depth(self) -> int:
         return self._results["depth"] if self._results else 0
 
+    def _table_np(self):
+        """(fingerprints, payloads) of the visited table as numpy arrays."""
+        return (
+            np.asarray(self._results["table_fp"]),
+            np.asarray(self._results["table_parent"]),
+        )
+
     def _parents(self) -> dict[int, int]:
         if self._parent_map is None:
-            tfp = np.asarray(self._results["table_fp"])
-            tpl = np.asarray(self._results["table_parent"])
+            tfp, tpl = self._table_np()
             occupied = tfp != np.uint64(MASK64)
             self._parent_map = dict(
                 zip(tfp[occupied].tolist(), tpl[occupied].tolist())

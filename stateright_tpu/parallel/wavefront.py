@@ -1,37 +1,52 @@
 """The TPU wavefront BFS engine — ``spawn_tpu()``.
 
 Replaces the reference's work-stealing threaded BFS (``src/checker/bfs.rs``)
-with frontier data-parallelism: each BFS level is a device array of encoded
-states; per wavefront the engine, entirely inside one jitted
-``lax.while_loop`` (zero host round-trips until the run finishes):
+with data-parallelism over states.  The engine keeps a device-resident FIFO
+**work queue** of encoded state rows and, per inner step (one jitted
+``lax.while_loop`` iteration), pops a fixed-size batch:
 
  1. evaluates all property conditions as fused boolean kernels over the
-    frontier (reference analogue ``bfs.rs:192-227``), recording first-hit
-    fingerprints per property (first-writer-wins, like the reference's benign
-    discovery races ``bfs.rs:197-207``, but deterministic here);
- 2. expands every state through the tensor model's static-arity transition
+    batch (reference analogue ``bfs.rs:192-227``), recording first-hit
+    fingerprints per property;
+ 2. expands every row through the tensor model's static-arity transition
     (``step_rows``), masking disabled/no-op actions;
- 3. flushes pending ``eventually`` bits at terminal states as liveness
+ 3. flushes pending ``eventually`` bits at terminal rows as liveness
     counterexamples (``bfs.rs:265-272``; the reference's documented DAG-join /
     cycle caveats are replicated since ebits are not fingerprinted);
- 4. fingerprints all successors, dedupes them (sort + first-occurrence mask),
-    and inserts into the HBM hash table (``ops/hashtable.py``), which stores
-    the parent fingerprint per slot — the device analogue of the reference's
-    ``DashMap<Fingerprint, Option<Fingerprint>>`` (``bfs.rs:26``);
- 5. compacts the novel survivors into the next frontier.
+ 4. fingerprints all successors, dedupes the batch (sort + first-occurrence
+    mask), and inserts into the HBM hash table (``ops/hashtable.py``), which
+    stores the parent fingerprint per slot — the device analogue of the
+    reference's ``DashMap<Fingerprint, Option<Fingerprint>>`` (``bfs.rs:26``);
+ 5. appends the novel survivors at the queue tail.
+
+Because the queue is FIFO and successors of depth-``d`` rows are appended
+after every depth-``d`` row was enqueued, pops are in exact BFS level order —
+parent pointers therefore record shortest paths, like single-threaded
+reference BFS.  The fixed expansion batch keeps every intermediate buffer
+small and independent of the state-space size (the round-1 design expanded a
+whole BFS level at once, whose worst-case buffers grew past what the backend
+could allocate).
+
+**Growth without lost work.**  All capacities are static shapes, but unlike
+the round-1 engine (restart from scratch with doubled capacity), the run
+stops at a *clean batch boundary* whenever the hash table passes 50%
+occupancy or the queue tail passes its high-water mark; the host then grows
+the offending buffer — rehashing the table or compacting/extending the queue
+in numpy — and resumes exactly where the device left off.  The same
+host-visible carry powers **checkpoint/resume** (SURVEY §5: wavefront
+checkpointing): :meth:`TpuChecker.checkpoint` snapshots the run mid-flight
+and ``spawn_tpu(resume=snapshot)`` continues it, surviving process restarts.
 
 Trace reconstruction is host-side and identical in spirit to the reference
 (``bfs.rs:314-342``): walk parent fingerprints back to an init state, then
 re-execute the *object-form* model (``Path.from_fingerprints``), which works
 because host and device fingerprint functions agree bit-for-bit.
-
-Capacities (hash-table slots / frontier rows) are static shapes; on overflow
-the engine restarts with doubled capacity (geometric, so wasted work is
-bounded by a constant factor).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import partial
 from typing import Optional
 
@@ -42,18 +57,38 @@ import jax.numpy as jnp
 
 from ..checker.base import CheckerBuilder
 from ..core import Expectation
+from ..ops.buckets import SLOTS, bucket_insert, host_bucket_rehash
 from ..ops.hashing import EMPTY, row_hash
-from ..ops.hashtable import dedupe_sorted, hash_insert
 from ._base import WavefrontChecker
 
 _STATUS_OK = 0
-_STATUS_FRONTIER_OVERFLOW = 1
-_STATUS_TABLE_OVERFLOW = 2
+_STATUS_QUEUE_FULL = 1
+_STATUS_TABLE_FULL = 2
+
+# Carry tuple indices (shared by the jitted program and the host loop).
+_TFP, _TPL, _CNT, _QROWS, _QFP, _QEBITS, _QDEPTH = 0, 1, 2, 3, 4, 5, 6
+_HEAD, _TAIL, _UNIQUE, _SCOUNT, _DISC, _MAXDEPTH, _STATUS = (
+    7, 8, 9, 10, 11, 12, 13,
+)
+
+_SNAPSHOT_KEYS = (
+    "table_fp", "table_parent", "counts", "q_rows", "q_fp", "q_ebits",
+    "q_depth", "head", "tail", "unique", "scount", "disc", "maxdepth",
+    "status",
+)
 
 
-def _build_run(tensor, props, cap: int, fcap: int, target: Optional[int]):
-    """Build the jitted whole-run function for fixed capacities."""
+def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
+                  steps: int, target: Optional[int]):
+    """Build ``(init_fn, run_fn)`` for fixed capacities.
+
+    ``qcap`` is the queue high-water mark; the buffers are over-allocated by
+    one batch's worth of candidates (``m``) so the dynamic slice/update at
+    ``head``/``tail`` is always in bounds without clamping.
+    """
     width, arity = tensor.width, tensor.max_actions
+    m = batch * arity
+    qalloc = qcap + m
     n_props = len(props)
     ev_idx = [
         i for i, p in enumerate(props) if p.expectation is Expectation.EVENTUALLY
@@ -73,7 +108,7 @@ def _build_run(tensor, props, cap: int, fcap: int, target: Optional[int]):
         return disc.at[i].set(jnp.where(take, fp, disc[i]))
 
     def eval_props(rows, fps, live, ebits, disc):
-        masks = tensor.property_masks(rows)  # [F, P] bool
+        masks = tensor.property_masks(rows)  # [B, P] bool
         for i, p in enumerate(props):
             if p.expectation is Expectation.ALWAYS:
                 disc = record_first(disc, i, live & ~masks[..., i], fps)
@@ -95,175 +130,444 @@ def _build_run(tensor, props, cap: int, fcap: int, target: Optional[int]):
             return jnp.bool_(False)
         return jnp.all(disc != jnp.uint64(0))
 
-    def insert_and_compact(tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits):
-        """Dedup candidates, claim table slots, compact novel rows into a
-        frontier-shaped buffer.  Returns updated tables + next frontier."""
-        m = cand_fp.shape[0]
-        order, first = dedupe_sorted(cand_fp)
-        sfp = cand_fp[order]
-        srows = cand_rows[order]
-        spar = cand_par[order]
-        sebt = cand_ebits[order]
-        tfp, tpl, novel, overflow = hash_insert(tfp, tpl, sfp, spar, first)
-        n_new = jnp.sum(novel)
-        keys = jnp.where(novel, jnp.arange(m, dtype=jnp.int32), jnp.int32(m))
-        perm = jnp.argsort(keys)[:fcap]
-        return (
-            tfp,
-            tpl,
-            srows[perm],
-            sfp[perm],
-            sebt[perm],
-            n_new.astype(jnp.int32),
-            overflow,
-        )
+    def step(carry):
+        """Pop one batch, expand, dedup+insert, append novel rows."""
+        (tfp, tpl, cnt, qrows, qfp, qebits, qdepth, head, tail,
+         unique, scount, disc, maxdepth, status) = carry
+        n_avail = tail - head
+        rows = jax.lax.dynamic_slice(qrows, (head, jnp.int32(0)), (batch, width))
+        fps = jax.lax.dynamic_slice(qfp, (head,), (batch,))
+        ebits = jax.lax.dynamic_slice(qebits, (head,), (batch,))
+        depths = jax.lax.dynamic_slice(qdepth, (head,), (batch,))
+        live = jnp.arange(batch, dtype=jnp.int32) < n_avail
 
-    def expand(carry):
-        (tfp, tpl, rows, fps, ebits, fcount, unique, scount, disc, depth, status) = carry
-        live = jnp.arange(fcap) < fcount
-        succ, valid = tensor.step_rows(rows)  # [F, A, W], [F, A]
-        valid = valid & live[:, None]
-        scount = scount + jnp.sum(valid, dtype=jnp.int64)
-        terminal = live & ~jnp.any(valid, axis=-1)
+        ebits, disc = eval_props(rows, fps, live, ebits, disc)
+        maxdepth = jnp.maximum(
+            maxdepth, jnp.max(jnp.where(live, depths, 0)).astype(jnp.int32)
+        )
+        # Mid-run early exit (reference ``bfs.rs:121-128``): stop expanding
+        # once every property has a discovery.
+        elive = live & ~all_discovered(disc)
+
+        succ, valid = tensor.step_rows(rows)  # [B, A, W], [B, A]
+        valid = valid & elive[:, None]
+        terminal = elive & ~jnp.any(valid, axis=-1)
         disc = flush_terminal(terminal, fps, ebits, disc)
 
-        cand_fp = jnp.where(valid, row_hash(succ), EMPTY).reshape(fcap * arity)
-        cand_rows = succ.reshape(fcap * arity, width)
-        cand_par = jnp.broadcast_to(fps[:, None], (fcap, arity)).reshape(-1)
-        cand_ebits = jnp.broadcast_to(ebits[:, None], (fcap, arity)).reshape(-1)
+        cand_fp = jnp.where(valid, row_hash(succ), EMPTY).reshape(m)
+        cand_rows = succ.reshape(m, width)
+        cand_par = jnp.broadcast_to(fps[:, None], (batch, arity)).reshape(-1)
+        cand_ebt = jnp.broadcast_to(ebits[:, None], (batch, arity)).reshape(-1)
+        cand_dep = jnp.broadcast_to(
+            depths[:, None] + jnp.uint32(1), (batch, arity)
+        ).reshape(-1)
 
-        tfp, tpl, nrows, nfps, nebits, n_new, toverflow = insert_and_compact(
-            tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits
+        tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
+            tfp, tpl, cnt, cand_fp, cand_par, window=batch
         )
-        unique = unique + n_new.astype(jnp.int64)
-        # n_new is clamped to what survived compaction only if it fits
-        foverflow = n_new > fcap
+        # Append novel rows (compacted to the perm front) at the queue tail.
+        # Rows past ``n_new`` in the written window are garbage; they sit in
+        # [tail+n_new, tail+m) which later appends overwrite before ``tail``
+        # ever reaches them.
+        sel = order[perm]  # compose the two gathers into one
+        qrows = jax.lax.dynamic_update_slice(qrows, cand_rows[sel], (tail, jnp.int32(0)))
+        qfp = jax.lax.dynamic_update_slice(qfp, cand_fp[sel], (tail,))
+        qebits = jax.lax.dynamic_update_slice(qebits, cand_ebt[sel], (tail,))
+        qdepth = jax.lax.dynamic_update_slice(qdepth, cand_dep[sel], (tail,))
+
+        # A bucket overflow means the insert wrote nothing: leave the cursors
+        # and counters untouched so the batch replays after the host grows
+        # the table.  (The queue append above wrote garbage past ``tail``,
+        # which the replay overwrites.)
+        head = jnp.where(overflow, head, head + jnp.minimum(n_avail, batch))
+        tail = jnp.where(overflow, tail, tail + n_new)
+        unique = jnp.where(overflow, unique, unique + n_new.astype(jnp.int64))
+        scount = jnp.where(
+            overflow, scount, scount + jnp.sum(valid, dtype=jnp.int64)
+        )
+        # Clean-boundary growth triggers: past these thresholds the host
+        # grows buffers and resumes (table target load ≤ 25%: the Poisson
+        # bucket-overflow tail stays negligible).
         status = jnp.where(
-            toverflow,
-            jnp.int32(_STATUS_TABLE_OVERFLOW),
-            jnp.where(foverflow, jnp.int32(_STATUS_FRONTIER_OVERFLOW), status),
+            overflow | (unique * 4 > cap) | (m * 4 > cap),
+            jnp.int32(_STATUS_TABLE_FULL),
+            jnp.where(tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status),
         )
-        depth = depth + jnp.where(n_new > 0, 1, 0).astype(jnp.int32)
-        return (tfp, tpl, nrows, nfps, nebits, n_new, unique, scount, disc, depth, status)
+        return (tfp, tpl, cnt, qrows, qfp, qebits, qdepth, head, tail,
+                unique, scount, disc, maxdepth, status)
 
-    def body(carry):
-        (tfp, tpl, rows, fps, ebits, fcount, unique, scount, disc, depth, status) = carry
-        live = jnp.arange(fcap) < fcount
-        ebits, disc = eval_props(rows, fps, live, ebits, disc)
-        carry = (tfp, tpl, rows, fps, ebits, fcount, unique, scount, disc, depth, status)
-        # Stop immediately once every property has a discovery, as the
-        # reference does mid-block (``bfs.rs:121-128``): skip the expansion.
-        return jax.lax.cond(
-            all_discovered(disc),
-            lambda c: c[:5] + (jnp.int32(0),) + c[6:],
-            expand,
-            carry,
-        )
-
-    def cond(carry):
-        (_, _, _, _, _, fcount, unique, _, disc, _, status) = carry
-        go = (status == jnp.int32(_STATUS_OK)) & (fcount > 0)
-        go = go & ~all_discovered(disc)
+    def cond(state):
+        k, carry = state
+        go = (carry[_STATUS] == jnp.int32(_STATUS_OK)) & (k < steps)
+        go = go & (carry[_TAIL] > carry[_HEAD]) & ~all_discovered(carry[_DISC])
         if target is not None:
-            go = go & (unique < jnp.int64(target))
+            go = go & (carry[_UNIQUE] < jnp.int64(target))
         return go
 
-    @partial(jax.jit)
-    def run():
+    def stats_of(carry):
+        """Pack every scalar the host loop reads into one small vector so a
+        host sync costs a single device round-trip (the tunnel RTT to a
+        remote TPU dwarfs the transfer itself)."""
+        return jnp.concatenate([
+            jnp.stack([
+                carry[_HEAD].astype(jnp.uint64),
+                carry[_TAIL].astype(jnp.uint64),
+                carry[_UNIQUE].astype(jnp.uint64),
+                carry[_SCOUNT].astype(jnp.uint64),
+                carry[_MAXDEPTH].astype(jnp.uint64),
+                carry[_STATUS].astype(jnp.uint64),
+            ]),
+            carry[_DISC],
+        ])
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run_fn(carry):
+        _, carry = jax.lax.while_loop(
+            cond, lambda s: (s[0] + 1, step(s[1])), (jnp.int32(0), carry)
+        )
+        return carry, stats_of(carry)
+
+    @jax.jit
+    def init_fn():
         tfp = jnp.full((cap,), EMPTY, jnp.uint64)
         tpl = jnp.zeros((cap,), jnp.uint64)
+        cnt = jnp.zeros((cap // SLOTS,), jnp.uint32)
+        qrows = jnp.zeros((qalloc, width), jnp.uint64)
+        qfp = jnp.full((qalloc,), EMPTY, jnp.uint64)
+        qebits = jnp.zeros((qalloc,), jnp.uint32)
+        qdepth = jnp.zeros((qalloc,), jnp.uint32)
+
         irows = jnp.asarray(init_rows_np)
         ifp = row_hash(irows)
-        # pad candidates to at least frontier shape handling
-        cand_rows = irows
-        cand_fp = ifp
-        cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = "is an init state"
-        cand_ebits = jnp.full((n_init,), init_ebits, jnp.uint32)
-        tfp, tpl, rows, fps, ebits, fcount, overflow = insert_and_compact(
-            tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits
+        tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
+            tfp, tpl, cnt, ifp,
+            jnp.zeros((n_init,), jnp.uint64),  # parent 0 = "is an init state"
+            window=n_init,
         )
-        # pad frontier buffers from n_init up to fcap
-        pad = fcap - rows.shape[0]
-        if pad > 0:
-            rows = jnp.concatenate([rows, jnp.zeros((pad, width), jnp.uint64)])
-            fps = jnp.concatenate([fps, jnp.full((pad,), EMPTY, jnp.uint64)])
-            ebits = jnp.concatenate([ebits, jnp.zeros((pad,), jnp.uint32)])
-        else:
-            rows, fps, ebits = rows[:fcap], fps[:fcap], ebits[:fcap]
+        sel = order[perm]
+        qrows = jax.lax.dynamic_update_slice(
+            qrows, irows[sel], (jnp.int32(0), jnp.int32(0))
+        )
+        qfp = jax.lax.dynamic_update_slice(qfp, ifp[sel], (jnp.int32(0),))
+        qebits = jax.lax.dynamic_update_slice(
+            qebits, jnp.full((n_init,), init_ebits, jnp.uint32), (jnp.int32(0),)
+        )
         status = jnp.where(
-            overflow, jnp.int32(_STATUS_TABLE_OVERFLOW), jnp.int32(_STATUS_OK)
+            overflow | (n_new.astype(jnp.int64) * 4 > cap) | (m * 4 > cap),
+            jnp.int32(_STATUS_TABLE_FULL),
+            jnp.int32(_STATUS_OK),
         )
-        carry = (
-            tfp,
-            tpl,
-            rows,
-            fps,
-            ebits,
-            fcount,
-            fcount.astype(jnp.int64),  # unique
-            jnp.int64(n_init),  # state_count counts all inits (bfs parity)
-            jnp.zeros((max(n_props, 1),), jnp.uint64),  # disc (min size 1)
-            jnp.int32(0),  # depth
-            status,
-        )
-        carry = jax.lax.while_loop(cond, body, carry)
-        (tfp, tpl, _, _, _, _, unique, scount, disc, depth, status) = carry
-        return tfp, tpl, unique, scount, disc, depth, status
+        carry = (tfp, tpl, cnt, qrows, qfp, qebits, qdepth,
+                 jnp.int32(0), n_new,
+                 n_new.astype(jnp.int64),
+                 jnp.int64(n_init),  # state_count counts all inits (bfs parity)
+                 jnp.zeros((max(n_props, 1),), jnp.uint64),
+                 jnp.int32(0),
+                 status)
+        return carry, stats_of(carry)
 
-    return run
+    return init_fn, run_fn
+
+
+def _repad_queue(carry_np: list, qalloc: int) -> None:
+    """Pad (EMPTY/0 fill) or truncate the queue buffers to ``qalloc`` rows,
+    in place.  Shared by snapshot-resume and growth."""
+    for i in (_QROWS, _QFP, _QEBITS, _QDEPTH):
+        arr = np.asarray(carry_np[i])
+        if arr.shape[0] < qalloc:
+            pad_shape = (qalloc - arr.shape[0],) + arr.shape[1:]
+            fill = EMPTY if i == _QFP else 0
+            arr = np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
+        carry_np[i] = arr[:qalloc] if arr.ndim == 1 else arr[:qalloc, :]
 
 
 class TpuChecker(WavefrontChecker):
-    """Wavefront BFS on the default JAX device (TPU on hardware, CPU in tests).
+    """Queue-based wavefront BFS on the default JAX device (TPU on hardware,
+    CPU in tests).
 
     Requires the model to provide a tensor twin via ``model.tensor_model()``
     and to fingerprint states via the row encoding (``TensorBackedModel``),
     so host-side path reconstruction matches device fingerprints.
+
+    ``capacity`` — hash-table slots (grown on demand, work preserved).
+    ``batch`` — rows expanded per device step (``frontier_capacity`` is the
+    backwards-compatible alias).  ``queue_capacity`` — queue high-water mark
+    (default: ``capacity // 2``; grown/compacted on demand).
+    ``steps_per_call`` — device steps per host round-trip: the host syncs
+    this often to refresh live counters and serve checkpoint requests.
+    ``resume`` — a snapshot from :meth:`checkpoint` to continue from.
     """
 
     def __init__(
         self,
         options: CheckerBuilder,
         capacity: int = 1 << 17,
-        frontier_capacity: int = 1 << 12,
+        frontier_capacity: Optional[int] = None,
+        batch: Optional[int] = None,
+        queue_capacity: Optional[int] = None,
+        steps_per_call: int = 64,
         sync: bool = False,
+        resume: Optional[dict] = None,
     ):
-        self._cap = capacity
-        self._fcap = frontier_capacity
+        self._cap = max(_pow2(capacity), 4 * SLOTS)
+        if batch is None:
+            batch = frontier_capacity if frontier_capacity else 1 << 11
+        self._batch = max(8, batch)
+        self._qcap = queue_capacity or max(self._cap // 2, 4 * self._batch)
+        self._steps = steps_per_call
+        self._resume = resume
+        self._live = (0, 0, 0)  # states, unique, maxdepth
+        self._live_lock = threading.Lock()
+        self._ckpt_req: Optional[threading.Event] = None
+        self._ckpt_out: Optional[dict] = None
+        self._ckpt_ready = threading.Event()
+        self._stop = threading.Event()
         self._init_common(options, sync)
 
     # -- run loop ------------------------------------------------------------
 
-    def _run(self):
-        cap, fcap = self._cap, self._fcap
-        # Compiled-run cache lives on the tensor model so repeated checks of
-        # the same system (warmup + timed bench runs) compile once.
+    def _engine(self, cap, qcap, batch):
         cache = getattr(self.tensor, "_run_cache", None)
         if cache is None:
             cache = {}
             self.tensor._run_cache = cache
+        key = (cap, qcap, batch, self._steps, self._target)
+        eng = cache.get(key)
+        if eng is None:
+            eng = _build_engine(
+                self.tensor, self._props, cap, qcap, batch, self._steps,
+                self._target,
+            )
+            cache[key] = eng
+        return eng
+
+    def _carry_to_snapshot(self, carry, cap, qcap) -> dict:
+        snap = {
+            k: np.asarray(v) for k, v in zip(_SNAPSHOT_KEYS, carry)
+        }
+        snap["cap"], snap["qcap"], snap["batch"] = cap, qcap, self._batch
+        snap["width"] = self.tensor.width
+        snap["model_sig"] = self._model_sig()
+        return snap
+
+    def _model_sig(self) -> np.ndarray:
+        """Model identity guard for resume: init fingerprints alone can
+        coincide across configurations (e.g. all-zero init rows), so the
+        tensor shape signature is included too."""
+        fps = [self.model.fingerprint_state(s) for s in self.model.init_states()]
+        return np.asarray(
+            sorted(fps)
+            + [self.tensor.width, self.tensor.max_actions, len(self._props)],
+            np.uint64,
+        )
+
+    def _pre_run_validate(self) -> None:
+        if self._resume is not None:
+            self._check_snapshot_sig(self._resume)
+
+    def _check_snapshot_sig(self, snap: dict) -> None:
+        if not np.array_equal(self._model_sig(), snap["model_sig"]):
+            raise ValueError(
+                "resume snapshot was taken from a different model "
+                "(init fingerprints / tensor signature disagree)"
+            )
+
+    def _snapshot_to_carry(self, snap: dict):
+        self._check_snapshot_sig(snap)
+        cap = int(snap["cap"])
+        qcap = int(snap["qcap"])
+        self._batch = int(snap.get("batch", self._batch))
+        qalloc = qcap + self._batch * self.tensor.max_actions
+        carry = [np.asarray(snap[k]) for k in _SNAPSHOT_KEYS]
+        # snapshots may have been taken at a different qalloc; re-pad
+        _repad_queue(carry, qalloc)
+        return cap, qcap, [jnp.asarray(c) for c in carry]
+
+    @staticmethod
+    def _grow(carry_np: list, cap: int, qcap: int, batch: int, arity: int,
+              status: int):
+        """Grow whatever is (near) full; returns (cap, qcap, carry).
+
+        Both conditions are always re-checked regardless of which status code
+        fired: table-full and queue-full can trip in the same batch, and
+        resuming with ``tail`` still past the high-water mark would let the
+        next append clamp its write window onto unexpanded queue rows.
+        """
+        def table_small():
+            return (int(carry_np[_UNIQUE]) * 4 > cap) or (
+                batch * arity * 4 > cap
+            )
+
+        if table_small() or status == _STATUS_TABLE_FULL:
+            if table_small():
+                while table_small():
+                    cap *= 2
+            elif status == _STATUS_TABLE_FULL:
+                cap *= 2  # a single bucket clustered past SLOTS entries
+            tfp, tpl, cnt = host_bucket_rehash(
+                carry_np[_TFP], carry_np[_TPL], cap // SLOTS
+            )
+            carry_np[_TFP], carry_np[_TPL], carry_np[_CNT] = tfp, tpl, cnt
+        head, tail = int(carry_np[_HEAD]), int(carry_np[_TAIL])
+        pending = tail - head
+        # reclaim the consumed prefix; grow only if still needed
+        for i in (_QROWS, _QFP, _QEBITS, _QDEPTH):
+            carry_np[i] = carry_np[i][head:tail].copy()
+        carry_np[_HEAD] = np.int32(0)
+        carry_np[_TAIL] = np.int32(pending)
+        while pending * 2 > qcap:
+            qcap *= 2
+        carry_np[_STATUS] = np.int32(_STATUS_OK)
+        _repad_queue(carry_np, qcap + batch * arity)
+        return cap, qcap, carry_np
+
+    def _run(self):
+        cap, qcap, batch = self._cap, self._qcap, self._batch
+        arity = self.tensor.max_actions
+        # the static precondition m*4 <= cap is known here; pre-size rather
+        # than paying an engine compile + re-init per doubling
+        while batch * arity * 4 > cap:
+            cap *= 2
+        self._cap = cap
+        if self._resume is not None:
+            cap, qcap, carry = self._snapshot_to_carry(self._resume)
+            batch = self._batch  # the snapshot's batch governs buffer layout
+            stats = None
+            # a snapshot taken at a growth boundary still carries the flag
+            st = int(np.asarray(carry[_STATUS]))
+            if st != _STATUS_OK:
+                carry_np = [np.asarray(c) for c in carry]
+                cap, qcap, carry_np = self._grow(
+                    carry_np, cap, qcap, batch, arity, st
+                )
+                carry = [jnp.asarray(c) for c in carry_np]
+        else:
+            while True:
+                init_fn, _ = self._engine(cap, qcap, batch)
+                carry, stats = init_fn()
+                carry = list(carry)
+                stats = np.asarray(stats)
+                # init insertion must be atomic: a table-full at init means
+                # nothing was written, so grow statically and re-init rather
+                # than resuming an inconsistent carry
+                if int(stats[5]) == _STATUS_OK:
+                    break
+                n_init = len(self.model.init_states())
+                prev = cap
+                while (n_init * 4 > cap) or (batch * arity * 4 > cap):
+                    cap *= 2
+                if cap == prev:
+                    cap *= 2  # guarantee progress on a clustered init set
+
         while True:
-            key = (cap, fcap, self._target)
-            run = cache.get(key)
-            if run is None:
-                run = _build_run(self.tensor, self._props, cap, fcap, self._target)
-                cache[key] = run
-            tfp, tpl, unique, scount, disc, depth, status = run()
-            status = int(status)
-            if status == _STATUS_TABLE_OVERFLOW:
-                cap *= 2
+            # one host sync per iteration: the packed stats vector
+            if stats is None:
+                stats = np.asarray(
+                    [np.asarray(carry[i]) for i in
+                     (_HEAD, _TAIL, _UNIQUE, _SCOUNT, _MAXDEPTH, _STATUS)]
+                    + list(np.asarray(carry[_DISC])), dtype=np.uint64
+                )
+            head, tail, unique, scount, maxdepth, status = (
+                int(stats[0]), int(stats[1]), int(stats[2]),
+                int(stats[3]), int(stats[4]), int(stats[5]),
+            )
+            disc = stats[6:]
+            if status != _STATUS_OK:
+                carry_np = [np.asarray(c) for c in carry]
+                cap, qcap, carry_np = self._grow(
+                    carry_np, cap, qcap, batch, arity, status
+                )
+                carry = [jnp.asarray(c) for c in carry_np]
+                stats = None
                 continue
-            if status == _STATUS_FRONTIER_OVERFLOW:
-                fcap *= 2
-                continue
-            break
-        self._cap, self._fcap = cap, fcap
+            with self._live_lock:
+                self._live = (scount, unique, maxdepth)
+            if self._ckpt_req is not None and self._ckpt_req.is_set():
+                self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap)
+                self._ckpt_req.clear()
+                self._ckpt_ready.set()
+            if self._stop.is_set():
+                break
+            done = tail <= head
+            if self._props and (disc != 0).all():
+                done = True
+            if self._target is not None and unique >= self._target:
+                done = True
+            if done:
+                break
+            _, run_fn = self._engine(cap, qcap, batch)
+            carry, stats = run_fn(tuple(carry))
+            carry = list(carry)
+            stats = np.asarray(stats)
+
+        self._cap, self._qcap = cap, qcap
+        # Keep final buffers on device; pulling the table/queue through the
+        # tunnel costs far more than the run's last batches, so snapshots and
+        # parent maps materialize lazily on demand.
+        self._final_carry = carry
         self._results = {
-            "unique": int(unique),
-            "states": int(scount),
+            "unique": unique,
+            "states": scount,
             "disc": np.asarray(disc),
-            "depth": int(depth),
-            "table_fp": tfp,
-            "table_parent": tpl,
+            "depth": maxdepth,
         }
         self._done.set()
+
+    @property
+    def _final_snapshot(self) -> dict:
+        return self._carry_to_snapshot(self._final_carry, self._cap, self._qcap)
+
+    def _table_np(self):
+        return (
+            np.asarray(self._final_carry[_TFP]),
+            np.asarray(self._final_carry[_TPL]),
+        )
+
+    # -- live progress + checkpointing ---------------------------------------
+
+    def state_count(self) -> int:
+        if self._results:
+            return self._results["states"]
+        return self._live[0]
+
+    def unique_state_count(self) -> int:
+        if self._results:
+            return self._results["unique"]
+        return self._live[1]
+
+    def stop(self) -> "TpuChecker":
+        """Ask the engine to stop at the next host sync (for checkpointing
+        a run that should be resumed elsewhere)."""
+        self._stop.set()
+        return self
+
+    def checkpoint(self, timeout: Optional[float] = 60.0) -> dict:
+        """Snapshot the run state (numpy arrays, serializable with
+        ``np.savez``).  Mid-run, the snapshot is taken at the next host sync
+        (at most ``steps_per_call`` device steps away); after completion it
+        reflects the final state.  Continue with ``spawn_tpu(resume=snap)``."""
+        if self._done.is_set():
+            return dict(self._final_snapshot)
+        if self._thread is None:  # sync run already finished
+            return dict(self._final_snapshot)
+        self._ckpt_req = self._ckpt_req or threading.Event()
+        self._ckpt_ready.clear()
+        self._ckpt_req.set()
+        # Poll in small increments: the run can finish between our request
+        # and its next checkpoint check, in which case the final snapshot is
+        # the answer and waiting out the full timeout would just stall.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._ckpt_ready.wait(0.2):
+            if self._done.is_set():
+                return dict(self._final_snapshot)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("checkpoint request not served")
+        out, self._ckpt_out = self._ckpt_out, None
+        return out
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

@@ -1,0 +1,98 @@
+"""Wavefront checkpoint/resume (SURVEY §5: "A TPU build at 20× throughput
+should add real wavefront checkpointing").
+
+The engine's whole run state is a host-visible carry (table, queue, counters,
+discovery fps); ``TpuChecker.checkpoint()`` snapshots it mid-run at a clean
+batch boundary and ``spawn_tpu(resume=snap)`` continues it — in the same
+process or after a serialize/deserialize round-trip.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def run_full(n, **kw):
+    return TwoPhaseSys(n).checker().spawn_tpu(sync=True, **kw)
+
+
+def test_killed_and_resumed_2pc7_matches_uninterrupted():
+    full = run_full(7)
+    expected_unique = full.unique_state_count()
+    expected_states = full.state_count()
+    expected_disc = {
+        name: len(path) for name, path in full.discoveries().items()
+    }
+    assert expected_unique > 100_000  # the run is big enough to interrupt
+
+    # interrupted run: small batches + frequent host syncs, checkpoint taken
+    # mid-flight, then the checker is stopped ("killed")
+    sys = TwoPhaseSys(7)
+    running = sys.checker().spawn_tpu(batch=256, steps_per_call=4)
+    snap = running.checkpoint(timeout=120.0)
+    running.stop()
+    running.join()
+    assert int(snap["head"]) < int(snap["tail"]), "checkpoint was not mid-run"
+    assert 0 < int(snap["unique"]) < expected_unique
+
+    resumed = TwoPhaseSys(7).checker().spawn_tpu(sync=True, resume=snap)
+    assert resumed.unique_state_count() == expected_unique
+    assert resumed.state_count() == expected_states
+    got_disc = {
+        name: len(path) for name, path in resumed.discoveries().items()
+    }
+    assert got_disc == expected_disc
+    resumed.assert_properties()
+
+
+def test_checkpoint_survives_npz_round_trip():
+    sys = TwoPhaseSys(5)
+    running = sys.checker().spawn_tpu(batch=64, steps_per_call=2)
+    snap = running.checkpoint(timeout=120.0)
+    running.stop()
+    running.join()
+
+    buf = io.BytesIO()
+    np.savez(buf, **snap)
+    buf.seek(0)
+    loaded = dict(np.load(buf))
+
+    resumed = TwoPhaseSys(5).checker().spawn_tpu(sync=True, resume=loaded)
+    assert resumed.unique_state_count() == 8832  # examples/2pc.rs:133
+    resumed.assert_properties()
+
+
+def test_resume_rejects_snapshot_from_different_model():
+    snap = run_full(3).checkpoint()
+    with pytest.raises(ValueError, match="different model"):
+        TwoPhaseSys(4).checker().spawn_tpu(sync=True, resume=snap)
+
+
+def test_checkpoint_after_completion_is_final_state():
+    checker = run_full(3)
+    snap = checker.checkpoint()
+    assert int(snap["unique"]) == 288  # examples/2pc.rs:128
+    assert int(snap["head"]) == int(snap["tail"])
+    # resuming a finished run is a no-op with identical results
+    resumed = TwoPhaseSys(3).checker().spawn_tpu(sync=True, resume=snap)
+    assert resumed.unique_state_count() == 288
+    resumed.assert_properties()
+
+
+def test_queue_growth_preserves_work():
+    # a queue high-water mark far below the state count forces repeated
+    # compaction/growth events mid-run; counts must still be exact
+    checker = run_full(5, queue_capacity=64, batch=32)
+    assert checker.unique_state_count() == 8832
+    assert checker._qcap > 64  # a growth event actually happened
+    checker.assert_properties()
+
+
+def test_table_growth_preserves_work():
+    checker = run_full(5, capacity=1 << 8, batch=32)
+    assert checker.unique_state_count() == 8832
+    assert checker._cap > (1 << 8)
+    checker.assert_properties()
