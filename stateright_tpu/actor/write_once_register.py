@@ -30,8 +30,16 @@ def PutFail(req_id) -> tuple:
 
 
 def record_returns(cfg, history, env):
+    """WO variant of :func:`.register.record_returns`: ``put_fail`` completes
+    the write with the spec's ``("write_fail",)``, and a null read return is
+    translated to ``None`` — the :class:`~stateright_tpu.semantics.WORegister`
+    spec models the unset register as ``None`` (the reference models it as
+    ``Option``, ``src/semantics/write_once_register.rs``) while the wire
+    protocol's null is :data:`~stateright_tpu.actor.register.NULL_VALUE`."""
     if env.msg[0] == "put_fail":
         return history.on_return(env.dst, ("write_fail",))
+    if env.msg[0] == "get_ok" and env.msg[2] == NULL_VALUE:
+        return history.on_return(env.dst, ("read_ok", None))
     return _record_returns(cfg, history, env)
 
 

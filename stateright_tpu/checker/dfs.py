@@ -63,7 +63,14 @@ class DfsChecker(WorkerPoolChecker):
 
     def _dedup_key(self, state) -> int:
         if self._symmetry is not None:
-            return self.model.fingerprint_state(self._symmetry(state))
+            # The symmetry-dedup key is internal to this run (never used for
+            # paths, URLs, or device tables), so it uses the structural hash:
+            # representatives permute states into configurations a
+            # tensor-backed fingerprint bridge may not be able to encode
+            # (e.g. outside a compiled twin's reachable closure).
+            from ..fingerprint import stable_hash
+
+            return stable_hash(self._symmetry(state))
         return self.model.fingerprint_state(state)
 
     def _insert(self, key: int) -> bool:

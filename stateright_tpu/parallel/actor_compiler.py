@@ -66,7 +66,7 @@ from .history_tensor import (
 from .tensor_model import BitPacker, TensorModel
 
 #: envelope-kind codes for the history/property tables
-_K_OTHER, _K_PUT_OK, _K_GET_OK = 0, 1, 2
+_K_OTHER, _K_PUT_OK, _K_GET_OK, _K_PUT_FAIL = 0, 1, 2, 3
 
 
 class CompileError(Exception):
@@ -137,11 +137,17 @@ class CompiledActorTensor(TensorModel):
         self.hist = LinHistoryCodec(
             self.clients,
             values,
-            NULL_VALUE,
+            # the write-once spec models the unset register as None; the
+            # wire protocol's null stays NULL_VALUE (translated at the
+            # get_ok boundary, mirroring the WO record_returns recorder)
+            None if self._wo else NULL_VALUE,
             tester_factory=lambda: type(model.init_history)(
                 model.init_history.init_ref_obj
             ),
             max_states=max_history_states,
+            write_rets=(("write_ok",), ("write_fail",))
+            if self._wo
+            else (("write_ok",),),
         )
 
         self._closure()
@@ -160,6 +166,8 @@ class CompiledActorTensor(TensorModel):
                 (f"h{c}_snap", max(1, 2 * (self.C - 1))),
                 (f"h{c}_rval", 3),
             ]
+            if self.hist.wfail_bits:
+                fields.append((f"h{c}_wfail", 1))
         fields.append(("poison", 1))
         self.pk = BitPacker(fields)
         self.pw = self.pk.width
@@ -192,13 +200,24 @@ class CompiledActorTensor(TensorModel):
                 "{'linearizable', 'value chosen'}; got " + repr(names)
             )
         from ..actor.register import record_invocations, record_returns
+        from ..actor.write_once_register import (
+            record_returns as wo_record_returns,
+        )
 
-        if (
-            m._record_msg_in is not record_returns
-            or m._record_msg_out is not record_invocations
-        ):
+        if m._record_msg_in is record_returns:
+            self._wo = False
+        elif m._record_msg_in is wo_record_returns:
+            # write-once workload: put_fail completes the write with
+            # ("write_fail",) and a null read maps to the spec's None
+            self._wo = True
+        else:
             # the device history update hard-codes these recorders' semantics
-            # (put_ok/get_ok -> returns, put/get sends -> invocations)
+            # (put_ok/put_fail/get_ok -> returns, put/get sends -> invocations)
+            raise CompileError(
+                "history recorders must be the standard register (or "
+                "write-once register) record_returns/record_invocations"
+            )
+        if m._record_msg_out is not record_invocations:
             raise CompileError(
                 "history recorders must be the standard register "
                 "record_returns/record_invocations"
@@ -382,9 +401,14 @@ class CompiledActorTensor(TensorModel):
         for c, e in enumerate(self._envs):
             if e.msg[0] == "put_ok":
                 kinds[c] = _K_PUT_OK
+            elif e.msg[0] == "put_fail":
+                kinds[c] = _K_PUT_FAIL
             elif e.msg[0] == "get_ok":
                 kinds[c] = _K_GET_OK
-                vals[c] = self.hist._value_code(e.msg[2])
+                v = e.msg[2]
+                if self._wo and v == NULL_VALUE:
+                    v = None
+                vals[c] = self.hist._value_code(v)
                 chosen[c] = e.msg[2] != NULL_VALUE
         self._env_kind = kinds
         self._env_val = vals
@@ -409,12 +433,14 @@ class CompiledActorTensor(TensorModel):
                     "(state_bound too tight, or a closure gap)"
                 )
             vals[f"a{i}"] = code
-        for c, (phase, snap, rval) in enumerate(
+        for c, (phase, snap, rval, wfail) in enumerate(
             self.hist.fields_of_tester(st.history)
         ):
             vals[f"h{c}_phase"] = phase
             vals[f"h{c}_snap"] = snap
             vals[f"h{c}_rval"] = rval
+            if self.hist.wfail_bits:
+                vals[f"h{c}_wfail"] = wfail
         vals["poison"] = 0
         return self.pk.pack(**vals) + self.codec.pack(
             st.network._counts.items()
@@ -432,7 +458,12 @@ class CompiledActorTensor(TensorModel):
         )
         tester = self.hist.tester_of_fields(
             [
-                (d[f"h{c}_phase"], d[f"h{c}_snap"], d[f"h{c}_rval"])
+                (
+                    d[f"h{c}_phase"],
+                    d[f"h{c}_snap"],
+                    d[f"h{c}_rval"],
+                    d.get(f"h{c}_wfail", 0) if self.hist.wfail_bits else 0,
+                )
                 for c in range(self.C)
             ]
         )
@@ -529,7 +560,11 @@ class CompiledActorTensor(TensorModel):
         if self.C:
             kind = cst["env_kind"][ecode]  # [B, NS]
             ci = self._client_of_dev()[jnp.clip(dst, 0, self.n_actors - 1)]
-            is_ret_w = valid & (kind == _K_PUT_OK) & (ci >= 0)
+            is_ret_w = (
+                valid
+                & ((kind == _K_PUT_OK) | (kind == _K_PUT_FAIL))
+                & (ci >= 0)
+            )
             is_ret_r = valid & (kind == _K_GET_OK) & (ci >= 0)
             rv = cst["env_val"][ecode]
             phases = jnp.stack(
@@ -575,6 +610,14 @@ class CompiledActorTensor(TensorModel):
                     f"h{c}_rval",
                     jnp.where(m_r, rv, cur_rv).astype(u64),
                 )
+                if self.hist.wfail_bits:
+                    m_wf = m_w & (kind == _K_PUT_FAIL)
+                    cur_wf = pk.get(rows, f"h{c}_wfail").astype(i32)[:, None]
+                    out = pk.set(
+                        out,
+                        f"h{c}_wfail",
+                        jnp.where(m_wf, 1, cur_wf).astype(u64),
+                    )
 
         cur_poison = pk.get(rows, "poison").astype(i32)[:, None]
         out = pk.set(
@@ -624,7 +667,16 @@ class CompiledActorTensor(TensorModel):
             [pk.get(rows, f"h{c}_rval").astype(i32) for c in range(self.C)],
             -1,
         )
-        keys = self.hist.device_key(phases, snaps, rvals)
+        wfails = None
+        if self.hist.wfail_bits:
+            wfails = jnp.stack(
+                [
+                    pk.get(rows, f"h{c}_wfail").astype(i32)
+                    for c in range(self.C)
+                ],
+                -1,
+            )
+        keys = self.hist.device_key(phases, snaps, rvals, wfails)
         linearizable = self.hist.device_lookup(keys)
 
         slots = rows[:, self.pw :]

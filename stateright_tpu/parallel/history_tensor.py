@@ -67,6 +67,7 @@ class LinHistoryCodec:
         null_value,
         tester_factory=None,
         max_states: int = 2_000_000,
+        write_rets: tuple = (("write_ok",),),
     ):
         if len(threads) > MAX_THREADS:
             raise ValueError(
@@ -76,11 +77,17 @@ class LinHistoryCodec:
         self.threads = [int(t) for t in threads]
         self.values = list(values)  # values[i] is thread i's written value
         self.null_value = null_value
+        self.write_rets = tuple(write_rets)
         self.C = C = len(threads)
         self.phase_bits = 2
         self.snap_bits = 2 * (C - 1)
         self.rval_bits = 3
-        self.thread_bits = self.phase_bits + self.snap_bits + self.rval_bits
+        # one extra bit per thread when a write can fail (write-once
+        # registers): which of the two write returns completed the op
+        self.wfail_bits = 1 if len(self.write_rets) > 1 else 0
+        self.thread_bits = (
+            self.phase_bits + self.snap_bits + self.rval_bits + self.wfail_bits
+        )
         if tester_factory is None:
             tester_factory = lambda: LinearizabilityTester(Register(null_value))
         self._tester_factory = tester_factory
@@ -88,18 +95,21 @@ class LinHistoryCodec:
 
     # -- field packing (host ints; the device mirrors this) ------------------
 
-    def pack_thread(self, phase: int, snap: int, rval: int) -> int:
+    def pack_thread(
+        self, phase: int, snap: int, rval: int, wfail: int = 0
+    ) -> int:
         return (
             phase
             | (snap << self.phase_bits)
             | (rval << (self.phase_bits + self.snap_bits))
+            | (wfail << (self.phase_bits + self.snap_bits + self.rval_bits))
         )
 
     def key_of_fields(self, fields: list) -> int:
-        """``fields[i] = (phase, snap, rval)`` per thread -> packed key."""
+        """``fields[i] = (phase, snap, rval, wfail)`` per thread -> key."""
         key = 0
-        for i, (phase, snap, rval) in enumerate(fields):
-            key |= self.pack_thread(phase, snap, rval) << (i * self.thread_bits)
+        for i, f in enumerate(fields):
+            key |= self.pack_thread(*f) << (i * self.thread_bits)
         return key
 
     # -- tester <-> fields ---------------------------------------------------
@@ -116,15 +126,17 @@ class LinHistoryCodec:
             w_expect = write(self.values[i])
             snap_src = None
             rval = 0
+            wfail = 0
             if len(completed) == 0:
                 if in_flight is None or in_flight[1] != w_expect:
                     raise ValueError(f"thread {t}: expected write in flight")
                 phase = PHASE_W_INFLIGHT
             else:
-                if completed[0][1] != w_expect or completed[0][2] != (
-                    "write_ok",
-                ):
+                if completed[0][1] != w_expect or completed[0][
+                    2
+                ] not in self.write_rets:
                     raise ValueError(f"thread {t}: unexpected first op")
+                wfail = int(completed[0][2] == ("write_fail",))
                 if len(completed) == 2:
                     snap_src, op, ret = completed[1]
                     if op != READ or ret[0] != "read_ok":
@@ -143,15 +155,18 @@ class LinHistoryCodec:
                 for peer, idx in snap_src:
                     j = self._thread_index(peer)
                     snap |= (idx + 1) << (2 * self._snap_slot(i, j))
-            fields.append((phase, snap, rval))
+            fields.append((phase, snap, rval, wfail))
         return fields
 
     def tester_of_fields(self, fields: list) -> LinearizabilityTester:
         history: dict = {}
         in_flight: dict = {}
-        for i, (phase, snap, rval) in enumerate(fields):
+        for i, f in enumerate(fields):
+            phase, snap, rval = f[0], f[1], f[2]
+            wfail = f[3] if len(f) > 3 else 0
             t = self.threads[i]
-            w_complete = ((), write(self.values[i]), ("write_ok",))
+            w_ret = ("write_fail",) if wfail else ("write_ok",)
+            w_complete = ((), write(self.values[i]), w_ret)
             snap_t = tuple(
                 sorted(
                     (self.threads[j], ((snap >> (2 * self._snap_slot(i, j))) & 3) - 1)
@@ -218,7 +233,9 @@ class LinHistoryCodec:
                     if op == READ:
                         succs = [tester.on_return(t, r) for r in read_rets]
                     else:
-                        succs = [tester.on_return(t, ("write_ok",))]
+                        succs = [
+                            tester.on_return(t, r) for r in self.write_rets
+                        ]
                 elif len(completed) == 1:
                     succs = [tester.on_invoke(t, READ)]
                 else:
@@ -239,7 +256,7 @@ class LinHistoryCodec:
 
     # -- device --------------------------------------------------------------
 
-    def device_key(self, phases, snaps, rvals):
+    def device_key(self, phases, snaps, rvals, wfails=None):
         """Pack per-thread field arrays (each ``[..., C]`` int32) into keys
         (int64), mirroring :meth:`key_of_fields`."""
         import jax.numpy as jnp
@@ -251,6 +268,11 @@ class LinHistoryCodec:
                 | (snaps[..., i] << self.phase_bits)
                 | (rvals[..., i] << (self.phase_bits + self.snap_bits))
             )
+            if wfails is not None and self.wfail_bits:
+                word = word | (
+                    wfails[..., i]
+                    << (self.phase_bits + self.snap_bits + self.rval_bits)
+                )
             key = key | (word.astype(jnp.int64) << (i * self.thread_bits))
         return key
 

@@ -77,6 +77,21 @@ _SNAPSHOT_KEYS = (
     "status",
 )
 
+# Packed stats-vector layout: [head, tail, unique, scount, maxdepth, status,
+# disc...].  Shared by the device-side ``stats_of`` and the host loop.
+_ST_HEAD, _ST_TAIL, _ST_UNIQUE, _ST_SCOUNT, _ST_MAXDEPTH, _ST_STATUS = range(6)
+_ST_DISC = 6
+_STATS_CARRY_ORDER = (_HEAD, _TAIL, _UNIQUE, _SCOUNT, _MAXDEPTH, _STATUS)
+
+
+def _stats_np(carry) -> np.ndarray:
+    """Host-side equivalent of the jitted ``stats_of`` (same layout)."""
+    return np.asarray(
+        [np.asarray(carry[i]) for i in _STATS_CARRY_ORDER]
+        + list(np.asarray(carry[_DISC])),
+        dtype=np.uint64,
+    )
+
 
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int]):
@@ -207,16 +222,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     def stats_of(carry):
         """Pack every scalar the host loop reads into one small vector so a
         host sync costs a single device round-trip (the tunnel RTT to a
-        remote TPU dwarfs the transfer itself)."""
+        remote TPU dwarfs the transfer itself).  Layout: ``_ST_*``."""
         return jnp.concatenate([
-            jnp.stack([
-                carry[_HEAD].astype(jnp.uint64),
-                carry[_TAIL].astype(jnp.uint64),
-                carry[_UNIQUE].astype(jnp.uint64),
-                carry[_SCOUNT].astype(jnp.uint64),
-                carry[_MAXDEPTH].astype(jnp.uint64),
-                carry[_STATUS].astype(jnp.uint64),
-            ]),
+            jnp.stack(
+                [carry[i].astype(jnp.uint64) for i in _STATS_CARRY_ORDER]
+            ),
             carry[_DISC],
         ])
 
@@ -255,7 +265,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         status = jnp.where(
             overflow | (n_new.astype(jnp.int64) * 4 > cap) | (m * 4 > cap),
             jnp.int32(_STATUS_TABLE_FULL),
-            jnp.int32(_STATUS_OK),
+            jnp.where(
+                n_new > qcap,  # init set alone past the high-water mark
+                jnp.int32(_STATUS_QUEUE_FULL),
+                jnp.int32(_STATUS_OK),
+            ),
         )
         carry = (tfp, tpl, cnt, qrows, qfp, qebits, qdepth,
                  jnp.int32(0), n_new,
@@ -424,11 +438,15 @@ class TpuChecker(WavefrontChecker):
     def _run(self):
         cap, qcap, batch = self._cap, self._qcap, self._batch
         arity = self.tensor.max_actions
-        # the static precondition m*4 <= cap is known here; pre-size rather
-        # than paying an engine compile + re-init per doubling
+        # static preconditions are known here; pre-size rather than paying an
+        # engine compile + re-init per doubling: m*4 <= cap, and the init set
+        # must fit the queue (its write window is qalloc = qcap + m)
         while batch * arity * 4 > cap:
             cap *= 2
-        self._cap = cap
+        n_init = len(np.asarray(self.tensor.init_rows()))
+        while n_init > qcap:
+            qcap *= 2
+        self._cap, self._qcap = cap, qcap
         if self._resume is not None:
             cap, qcap, carry = self._snapshot_to_carry(self._resume)
             batch = self._batch  # the snapshot's batch governs buffer layout
@@ -449,8 +467,10 @@ class TpuChecker(WavefrontChecker):
                 stats = np.asarray(stats)
                 # init insertion must be atomic: a table-full at init means
                 # nothing was written, so grow statically and re-init rather
-                # than resuming an inconsistent carry
-                if int(stats[5]) == _STATUS_OK:
+                # than resuming an inconsistent carry.  A queue-full init is
+                # consistent (table + queue both hold every init row) and the
+                # main loop's generic growth compacts/extends it in place.
+                if int(stats[_ST_STATUS]) != _STATUS_TABLE_FULL:
                     break
                 n_init = len(self.model.init_states())
                 prev = cap
@@ -462,16 +482,13 @@ class TpuChecker(WavefrontChecker):
         while True:
             # one host sync per iteration: the packed stats vector
             if stats is None:
-                stats = np.asarray(
-                    [np.asarray(carry[i]) for i in
-                     (_HEAD, _TAIL, _UNIQUE, _SCOUNT, _MAXDEPTH, _STATUS)]
-                    + list(np.asarray(carry[_DISC])), dtype=np.uint64
-                )
+                stats = _stats_np(carry)
             head, tail, unique, scount, maxdepth, status = (
-                int(stats[0]), int(stats[1]), int(stats[2]),
-                int(stats[3]), int(stats[4]), int(stats[5]),
+                int(stats[_ST_HEAD]), int(stats[_ST_TAIL]),
+                int(stats[_ST_UNIQUE]), int(stats[_ST_SCOUNT]),
+                int(stats[_ST_MAXDEPTH]), int(stats[_ST_STATUS]),
             )
-            disc = stats[6:]
+            disc = stats[_ST_DISC:]
             if status != _STATUS_OK:
                 carry_np = [np.asarray(c) for c in carry]
                 cap, qcap, carry_np = self._grow(

@@ -96,3 +96,68 @@ def test_table_growth_preserves_work():
     assert checker.unique_state_count() == 8832
     assert checker._cap > (1 << 8)
     checker.assert_properties()
+
+
+def test_many_init_states_fit_tiny_queue():
+    """A model whose init set alone exceeds the queue high-water mark must
+    grow cleanly instead of clamp-corrupting the init write (regression:
+    init_fn only checked table occupancy)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from stateright_tpu import Expectation, Model, Property
+    from stateright_tpu.parallel.tensor_model import (
+        TensorBackedModel,
+        TensorModel,
+    )
+
+    N = 100  # init states; queue_capacity below is far smaller
+
+    class ManyTensor(TensorModel):
+        width = 1
+        max_actions = 1
+
+        def __init__(self, model):
+            self.model = model
+
+        def init_rows(self):
+            return np.arange(1, N + 1, dtype=np.uint64).reshape(N, 1)
+
+        def encode_state(self, s):
+            return (s,)
+
+        def decode_state(self, row):
+            return int(row[0])
+
+        def step_rows(self, rows):
+            # each state n steps to n+N once, then n+N is terminal
+            w = rows[..., 0]
+            succ = (w + jnp.uint64(N))[..., None, None]
+            valid = (w <= jnp.uint64(N))[..., None]
+            return succ, valid
+
+        def property_masks(self, rows):
+            return jnp.ones(rows.shape[:-1] + (1,), bool)
+
+    class Many(TensorBackedModel, Model):
+        def tensor_model(self):
+            return ManyTensor(self)
+
+        def init_states(self):
+            return list(range(1, N + 1))
+
+        def actions(self, s):
+            return [0] if s <= N else []
+
+        def next_state(self, s, a):
+            return s + N
+
+        def properties(self):
+            return [Property(Expectation.ALWAYS, "ok", lambda m, s: True)]
+
+    checker = Many().checker().spawn_tpu(
+        sync=True, queue_capacity=16, batch=8, capacity=1 << 10
+    )
+    assert checker.unique_state_count() == 2 * N
+    assert checker.state_count() == 2 * N  # N inits + N successors
+    checker.assert_properties()
