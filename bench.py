@@ -131,6 +131,13 @@ def cpu_phase() -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _mark(stage: str) -> None:
+    """Progress mark on stderr: when the parent kills a hung child, the
+    last mark pinpoints the stage that never returned."""
+    sys.stderr.write(f"bench-tpu-stage: {stage}\n")
+    sys.stderr.flush()
+
+
 def tpu_phase() -> dict:
     from stateright_tpu.models.paxos import paxos_model
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
@@ -139,11 +146,17 @@ def tpu_phase() -> dict:
     budget = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
     out: dict = {}
 
+    _mark("backend-init (jax.devices)")
+    with_tpu_retry(_device_names)
+    _mark("backend-up")
+
     # parity gates on device (capacities sized so no growth event interrupts)
     tpu_p2 = with_tpu_retry(
         lambda: paxos_model(2).checker().spawn_tpu(sync=True, capacity=1 << 18)
     )
+    _mark("paxos2 parity done")
     tpu_t5 = TwoPhaseSys(5).checker().spawn_tpu(sync=True, capacity=1 << 17)
+    _mark("2pc5 parity done")
     if tpu_p2.unique_state_count() != PAXOS2_UNIQUE:
         raise AssertionError(
             f"tpu paxos2 unique {tpu_p2.unique_state_count()} != {PAXOS2_UNIQUE}"
@@ -168,7 +181,9 @@ def tpu_phase() -> dict:
         return b.spawn_tpu(sync=True, **caps)
 
     with_tpu_retry(spawn3)  # warm-up (compile)
+    _mark("paxos3 warm-up done")
     tpu_p3, dt = timed(spawn3)
+    _mark("paxos3 timed run done")
     out["tpu_paxos3_states_per_sec"] = round(tpu_p3.state_count() / dt, 1)
     out["tpu_paxos3_states"] = tpu_p3.state_count()
     out["tpu_paxos3_unique"] = tpu_p3.unique_state_count()
@@ -206,33 +221,43 @@ def _device_names() -> list:
 
 def run_tpu_subprocess(timeout_s: float) -> dict:
     """Run ``tpu_phase`` in a child; a backend hang cannot take down the
-    parent's JSON line."""
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--tpu-child"],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.communicate()
-        return {
-            "error": f"TPU phase timed out after {timeout_s:.0f}s "
-            "(backend init hang?)"
-        }
-    for line in reversed(stdout.strip().splitlines()):
+    parent's JSON line.  Child stderr goes to a temp file (not a pipe) so
+    that even after a timeout-kill the staged progress marks survive and
+    the JSON can say exactly which stage hung."""
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+", errors="replace") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--tpu-child"],
+            stdout=subprocess.PIPE,
+            stderr=errf,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+
+        def err_tail(n: int = 8) -> list:
+            errf.flush()
+            errf.seek(0)
+            return errf.read().strip().splitlines()[-n:]
+
         try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
-    tail = (stderr or stdout or "").strip().splitlines()[-6:]
-    return {
-        "error": f"TPU phase exited rc={proc.returncode} without JSON",
-        "tpu_trace_tail": tail,
-    }
+            stdout, _ = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            return {
+                "error": f"TPU phase timed out after {timeout_s:.0f}s",
+                "tpu_trace_tail": err_tail(),
+            }
+        for line in reversed(stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        return {
+            "error": f"TPU phase exited rc={proc.returncode} without JSON",
+            "tpu_trace_tail": err_tail() or stdout.strip().splitlines()[-8:],
+        }
 
 
 def main() -> int:

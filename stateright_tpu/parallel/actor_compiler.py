@@ -676,8 +676,11 @@ class CompiledActorTensor(TensorModel):
                 ],
                 -1,
             )
-        keys = self.hist.device_key(phases, snaps, rvals, wfails)
-        linearizable = self.hist.device_lookup(keys)
+        if self.hist.strategy == "closure":
+            linearizable = self.hist.device_verdict(phases, snaps, rvals)
+        else:
+            keys = self.hist.device_key(phases, snaps, rvals, wfails)
+            linearizable = self.hist.device_lookup(keys)
 
         slots = rows[:, self.pw :]
         occ = slots != u64(SLOT_EMPTY)

@@ -34,9 +34,28 @@ Per-thread fields (2 + 2·(C−1) + 3 bits):
  - ``rval``: index of the value the read returned (0 = the register's
    initial/null value, 1.. = client values), once phase = 2.
 
-The key width caps supported client counts at 4 (2+2·3+3 = 11 bits × 4
-threads = 44-bit keys); beyond that the joint enumeration also becomes the
-bottleneck.
+The key width caps the **table strategy** at 4 clients (2+2·3+3 = 11 bits ×
+4 threads = 44-bit keys); beyond that the joint enumeration also becomes the
+bottleneck.  For the plain-register workload (every write returns
+``write_ok``) the codec instead uses the **closure strategy**
+(:meth:`LinHistoryCodec.device_verdict`): the exhaustive interleaving search
+reduces to an acyclicity check on a C×C precedence graph over the writes —
+O(C³) vectorized boolean ops per state, no enumeration, no key packing —
+which scales to the reference's ``paxos check 6`` bench config and beyond.
+
+Why the reduction is exact (put_count=1 register workload): every client
+invokes its write at start (so writes have no prerequisites and no
+write→write real-time order), in-flight ops may always be left unserialized
+(``_serialize``'s base case), and each completed read R_i must sit
+immediately after its dictating write W_d(i) (unique values).  A
+serialization therefore exists iff some permutation π of the writes
+satisfies π(k) ≤ π(d(i)) for every write k completed before R_i's
+invocation (plus k = i), and π(d(j)) ≤ π(d(i)) for every read R_j completed
+before R_i's invocation — all strict edges between distinct writes, so a
+valid π exists iff the edge graph is acyclic.  A completed read returning
+the null value is always a violation (its own write precedes it).  The
+closure verdict is cross-validated exhaustively against the object tester in
+``tests/test_history_closure.py``.
 """
 
 from __future__ import annotations
@@ -53,12 +72,22 @@ PHASE_R_INFLIGHT = 1
 PHASE_DONE = 2
 PHASE_W_DONE = 3
 
+#: thread cap for the enumerated-table strategy (63-bit key width)
 MAX_THREADS = 4
+#: thread cap for the closure strategy (3-bit rval field: ≤7 client values)
+MAX_THREADS_CLOSURE = 7
 
 
 class LinHistoryCodec:
     """Host+device codec for the joint linearizability-tester state of a
-    ``put_count=1`` register workload."""
+    ``put_count=1`` register workload.
+
+    ``strategy`` is ``"closure"`` for plain-register workloads (every write
+    returns ``write_ok``): the verdict is computed directly on device by
+    :meth:`device_verdict`, with no enumeration.  Write-once workloads (a
+    write may return ``write_fail``, changing which write takes effect) use
+    ``"table"``: enumerate every reachable joint tester state host-side and
+    ship ``(sorted keys, verdicts)`` for a binary-search lookup."""
 
     def __init__(
         self,
@@ -69,15 +98,19 @@ class LinHistoryCodec:
         max_states: int = 2_000_000,
         write_rets: tuple = (("write_ok",),),
     ):
-        if len(threads) > MAX_THREADS:
+        self.write_rets = tuple(tuple(r) for r in write_rets)
+        self.strategy = (
+            "closure" if self.write_rets == (("write_ok",),) else "table"
+        )
+        cap = MAX_THREADS_CLOSURE if self.strategy == "closure" else MAX_THREADS
+        if len(threads) > cap:
             raise ValueError(
-                f"at most {MAX_THREADS} client threads supported "
-                f"(got {len(threads)})"
+                f"at most {cap} client threads supported for the "
+                f"{self.strategy} strategy (got {len(threads)})"
             )
         self.threads = [int(t) for t in threads]
         self.values = list(values)  # values[i] is thread i's written value
         self.null_value = null_value
-        self.write_rets = tuple(write_rets)
         self.C = C = len(threads)
         self.phase_bits = 2
         self.snap_bits = 2 * (C - 1)
@@ -91,7 +124,11 @@ class LinHistoryCodec:
         if tester_factory is None:
             tester_factory = lambda: LinearizabilityTester(Register(null_value))
         self._tester_factory = tester_factory
-        self._enumerate(max_states)
+        self._max_states = max_states
+        self._table_built = False  # built lazily: the closure strategy never
+        # needs the table, and enumeration is super-exponential in C
+        if self.strategy == "table":
+            self.ensure_table()
 
     # -- field packing (host ints; the device mirrors this) ------------------
 
@@ -208,6 +245,11 @@ class LinHistoryCodec:
 
     # -- enumeration ---------------------------------------------------------
 
+    def ensure_table(self) -> None:
+        if not self._table_built:
+            self._enumerate(self._max_states)
+            self._table_built = True
+
     def _enumerate(self, max_states: int) -> None:
         """BFS over invoke/return events; superset of protocol-reachable
         joint tester states."""
@@ -282,9 +324,68 @@ class LinHistoryCodec:
         produce) return False."""
         import jax.numpy as jnp
 
+        self.ensure_table()
         tk = jnp.asarray(self.table_keys)
         ok = jnp.asarray(self.table_ok)
         idx = jnp.clip(
             jnp.searchsorted(tk, keys, side="left"), 0, tk.shape[0] - 1
         )
         return ok[idx] & (tk[idx] == keys)
+
+    def device_verdict(self, phases, snaps, rvals):
+        """Closure-strategy verdict, computed per state on device.
+
+        Each input is ``[..., C]`` int32 (the per-thread row fields); returns
+        ``[...]`` bool.  Builds the precedence graph over writes described in
+        the module docstring and tests it for cycles via ``log2(C)`` boolean
+        matrix squarings.  Exact for the plain-register workload; write-fail
+        workloads must use :meth:`device_lookup` (a failed write takes no
+        effect, which breaks the reads-dictate-writes reduction).
+        """
+        import jax.numpy as jnp
+
+        if self.strategy != "closure":
+            raise ValueError(
+                "device_verdict is only exact for the plain-register "
+                "workload; this codec's strategy is " + self.strategy
+            )
+        C = self.C
+        batch = phases.shape[:-1]
+        done = phases == PHASE_DONE  # [..., C] completed reads
+        null_read = jnp.any(done & (rvals == 0), axis=-1)
+        d = jnp.clip(rvals - 1, 0, C - 1)  # dictating writer per read
+
+        # s[..., i, j] = ops thread j had completed when R_i was invoked
+        s = jnp.zeros(batch + (C, C), jnp.int32)
+        for i in range(C):
+            for j in range(C):
+                if j == i:
+                    continue
+                slot = self._snap_slot(i, j)
+                s = s.at[..., i, j].set((snaps[..., i] >> (2 * slot)) & 3)
+
+        eye = jnp.eye(C, dtype=bool)
+        d_oh = eye[d]  # [..., C, C]: d_oh[..., i, :] = one-hot of d(i)
+        edges = jnp.zeros(batch + (C, C), bool)
+        for i in range(C):
+            di = d_oh[..., i, :]  # [..., C] target one-hot
+            gate = done[..., i, None, None]
+            # writes that must precede R_i: its own, plus every write
+            # completed before R_i's invocation -> edge k -> d(i)
+            pre = (s[..., i, :] >= 1) | eye[i]
+            edges = edges | (gate & pre[..., :, None] & di[..., None, :])
+            # reads completed before R_i's invocation: R_j < R_i forces
+            # window order -> edge d(j) -> d(i)
+            rr = (s[..., i, :] == 2) & done  # [..., C] over j
+            src = jnp.any(rr[..., :, None] & d_oh, axis=-2)  # [..., C]
+            edges = edges | (gate & src[..., :, None] & di[..., None, :])
+        edges = edges & ~eye  # k == d(i) cases are vacuous, not cycles
+
+        # transitive closure by squaring; cycle <=> any diagonal entry
+        reach = edges
+        for _ in range(max(1, (C - 1).bit_length())):
+            reach = reach | jnp.any(
+                reach[..., :, :, None] & reach[..., None, :, :], axis=-2
+            )
+        cycle = jnp.any(reach & eye, axis=(-2, -1))
+        return ~(null_read | cycle)

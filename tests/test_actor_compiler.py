@@ -27,6 +27,7 @@ from test_paxos_tensor import crawl_and_check
 
 def test_history_codec_roundtrip_and_verdicts():
     hc = LinHistoryCodec([3, 4], ["A", "B"], "\0")
+    hc.ensure_table()  # the closure strategy no longer enumerates eagerly
     # every enumerated joint state round-trips and the baked verdict equals
     # the live tester's
     seen = 0
