@@ -185,23 +185,23 @@ class PaxosServer(Actor):
 class PaxosModel(TensorBackedModel, ActorModel):
     """ActorModel specialization carrying a tensor (device) twin.
 
-    The benchmark configuration — 3 servers, 1..3 clients doing one put
+    The benchmark configuration — 3 servers, 1..7 clients doing one put
     each, unordered non-duplicating lossless network — uses the hand-tuned
-    twin (``paxos_tensor.py``).  Other configurations (4 clients, ≠3
-    servers) fall back to the mechanical compiler
-    (``parallel/actor_compiler.py``); configurations neither supports fall
-    back to structural fingerprints and CPU checking.  Eligibility is
-    derived from the live builder state."""
+    twin (``paxos_tensor.py``), which covers the reference's ``paxos check
+    6`` bench config.  Other configurations (≠3 servers) fall back to the
+    mechanical compiler (``parallel/actor_compiler.py``); configurations
+    neither supports fall back to structural fingerprints and CPU checking.
+    Eligibility is derived from the live builder state."""
 
     def tensor_model(self):
         from ..actor.network import UnorderedNonDuplicatingNetwork
-        from .paxos_tensor import PaxosTensor
+        from .paxos_tensor import MAX_CLIENTS, PaxosTensor
 
         servers = sum(isinstance(a, PaxosServer) for a in self.actors)
         clients = self.actors[servers:]
         if (
             servers == 3
-            and 1 <= len(clients) <= 3
+            and 1 <= len(clients) <= MAX_CLIENTS
             and all(
                 isinstance(a, RegisterClient) and a.put_count == 1
                 for a in clients
@@ -276,6 +276,18 @@ def main(argv=None):
             default_threads()
         ).spawn_dfs().report()
 
+    def check_tpu(rest):
+        client_count = int(rest[0]) if rest else 2
+        target = int(rest[1]) if len(rest) > 1 else None
+        print(
+            f"Model checking Single Decree Paxos with {client_count} clients "
+            "on the device wavefront engine."
+        )
+        b = paxos_model(client_count, 3).checker()
+        if target:
+            b = b.target_states(target)
+        b.spawn_tpu().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -303,9 +315,11 @@ def main(argv=None):
 
     run_cli(
         "  paxos check [CLIENT_COUNT] [NETWORK]\n"
+        "  paxos check-tpu [CLIENT_COUNT] [TARGET_STATES]\n"
         "  paxos explore [CLIENT_COUNT] [ADDRESS]\n"
         "  paxos spawn",
         check,
+        check_tpu=check_tpu,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

@@ -14,22 +14,27 @@ Design notes:
    one-``Deliver``-per-distinct-envelope actions (``actor/model.py``,
    reference ``src/actor/model.rs:214-239``).
  - **Message universe**: every Paxos message is determined by a handful of
-   small fields (kind, src, dst, ballot round/leader, and a 6-bit payload:
-   a proposal's client index, a ``last_accepted`` code, or a read value), so
-   an envelope packs into 21 bits.  Request ids and values are derivable:
-   client ``i``'s put is always ``Put(3+i, chr(65+i))`` and its get
-   ``Get(2*(3+i))`` (``actor/register.py``).
+   small fields (kind, src, dst, ballot round/leader, and an aux payload:
+   a proposal's client index, a ``last_accepted`` code, or a read value).
+   Field widths are C-dependent (~21 bits at C ≤ 3, ~26 at C = 7), far
+   inside the slot codec's 58-bit envelope budget.  Request ids and values
+   are derivable: client ``i``'s put is always ``Put(3+i, chr(65+i))`` and
+   its get ``Get(2*(3+i))`` (``actor/register.py``).
  - **History**: with ``put_count=1`` clients, the linearizability tester's
    state is a function of (per-thread phase, read return value, and the
-   read-invocation snapshot of peer completion counts) — 9 bits per client.
- - **Linearizable property**: evaluated *on device* as an exhaustive search
-   over a precomputed permutation table of the ≤2C operations; program-order
-   / real-time / register-semantics validity of each permutation is
-   precomputed in numpy, so the per-state work is a handful of [B, P]
-   boolean ops (P = (2C)! ≤ 720 for C ≤ 3).  This replaces the reference's
-   per-state recursive interleaving search
-   (``src/semantics/linearizability.rs:178-240``) with a wavefront-wide
-   fused kernel.
+   read-invocation snapshot of peer completion counts).
+ - **Linearizable property**: evaluated *on device* by the closure strategy
+   (``parallel/history_tensor.py::closure_verdict``): the exhaustive
+   interleaving search of the reference
+   (``src/semantics/linearizability.rs:178-240``) reduces exactly, for this
+   workload, to an acyclicity check on a C×C write-precedence graph —
+   O(C³ log C) vectorized boolean ops per state, which is what lets the
+   twin scale to the reference's ``paxos check 6`` bench configuration
+   (an earlier revision used a (2C)! permutation table, capped at C = 3).
+ - **Field widths** are computed from C (ballot rounds ≤ C since each put
+   starts exactly one ballot; ``last_accepted`` codes grow with C·rnd), so
+   one row layout serves C = 1..7; the C ≤ 7 cap comes from the 3-bit read
+   value code and the closure strategy's own cap.
  - **No-op pruning** parity: deliveries whose handler returns None with no
    sends are masked invalid, exactly mirroring the object model's prune
    (reference ``model.rs:253-260``); equality-returning handlers (e.g. a
@@ -37,8 +42,6 @@ Design notes:
 """
 
 from __future__ import annotations
-
-from itertools import permutations
 
 import numpy as np
 
@@ -64,13 +67,7 @@ S = 3  # servers (the benchmark configuration is fixed at 3)
 PUT, GET, PUT_OK, GET_OK = 1, 2, 3, 4
 PREPARE, PREPARED, ACCEPT, ACCEPTED, DECIDED = 5, 6, 7, 8, 9
 
-# envelope code bit layout: kind | src | dst | rnd | ldr | aux
-_AUX_B, _LDR_B, _RND_B, _DST_B, _SRC_B = 6, 2, 3, 3, 3
-_LDR_S = _AUX_B
-_RND_S = _LDR_S + _LDR_B
-_DST_S = _RND_S + _RND_B
-_SRC_S = _DST_S + _DST_B
-_KIND_S = _SRC_S + _SRC_B
+MAX_CLIENTS = 7  # 3-bit read-value code + the closure strategy's own cap
 
 
 class PaxosTensor(TensorModel):
@@ -79,25 +76,44 @@ class PaxosTensor(TensorModel):
     ``examples/paxos.rs:323-338``)."""
 
     def __init__(self, model, client_count: int, n_slots: int | None = None):
-        if client_count > 3:
+        if client_count > MAX_CLIENTS:
             raise ValueError(
-                "tensor paxos supports <=3 clients ((2C)! permutation table)"
+                f"tensor paxos supports <={MAX_CLIENTS} clients"
             )
         self.model = model
         self.C = C = client_count
         self.n_slots = n_slots if n_slots is not None else max(16, 10 * C)
         self.max_actions = self.n_slots
+
+        # -- C-dependent widths --------------------------------------------
+        # Each put starts exactly one ballot (k_put consumes one of the C PUT
+        # envelopes on a non-duplicating network), so rounds never exceed C.
+        self.max_rnd = max_rnd = max(C, 1)
+        la_max = 1 + ((max_rnd - 1) * S + (S - 1)) * C + (C - 1)
+        self._aux_b = max(6, la_max.bit_length())
+        self._rnd_b = max(3, max_rnd.bit_length())
+        self._id_b = max(3, (S + C - 1).bit_length())
+        # envelope code bit layout: kind | src | dst | rnd | ldr | aux
+        self._ldr_s = self._aux_b
+        self._rnd_s = self._ldr_s + 2
+        self._dst_s = self._rnd_s + self._rnd_b
+        self._src_s = self._dst_s + self._id_b
+        self._kind_s = self._src_s + self._id_b
+        self._la_max = la_max
+        prep_b = (la_max + 1).bit_length()
+        prop_b = max(3, (C + 1).bit_length())
+
         fields = []
         for s in range(S):
             fields += [
-                (f"s{s}_rnd", 3),
+                (f"s{s}_rnd", self._rnd_b),
                 (f"s{s}_ldr", 2),
-                (f"s{s}_prop", 3),
-                (f"s{s}_prep0", 6),
-                (f"s{s}_prep1", 6),
-                (f"s{s}_prep2", 6),
+                (f"s{s}_prop", prop_b),
+                (f"s{s}_prep0", prep_b),
+                (f"s{s}_prep1", prep_b),
+                (f"s{s}_prep2", prep_b),
                 (f"s{s}_acc", 3),
-                (f"s{s}_accd", 6),
+                (f"s{s}_accd", self._aux_b),
                 (f"s{s}_dec", 1),
             ]
         for c in range(C):
@@ -111,21 +127,21 @@ class PaxosTensor(TensorModel):
         self.pw = self.pk.width
         self.width = self.pw + self.n_slots
         self.codec = SlotCodec(self.n_slots, self._encode_env, self._decode_env)
-        self._perm_tables = _perm_tables(C)
 
     # ------------------------------------------------------------------
     # host-side: la / proposal / envelope codes
     # ------------------------------------------------------------------
 
     def _la_code(self, la) -> int:
-        """Option<(Ballot, Proposal)> -> 6-bit code; numeric order matches the
-        tuple order used by the prepare-quorum ``max`` (``paxos.py``)."""
+        """Option<(Ballot, Proposal)> -> ``_aux_b``-bit code; numeric order
+        matches the tuple order used by the prepare-quorum ``max``
+        (``paxos.py``)."""
         if la is None:
             return 0
         (rnd, ldr), proposal = la
         ci = int(proposal[1]) - S
         code = 1 + ((rnd - 1) * S + int(ldr)) * self.C + ci
-        assert 0 < code < 64, la
+        assert 0 < code <= self._la_max, la
         return code
 
     def _la_decode(self, code: int):
@@ -169,23 +185,24 @@ class PaxosTensor(TensorModel):
                 kind, aux = DECIDED, int(im[2][1]) - S
             else:
                 raise ValueError(f"unknown internal message {im!r}")
-        assert rnd < 8 and aux < 64, env
+        assert rnd <= self.max_rnd and aux < (1 << self._aux_b), env
         return (
-            (kind << _KIND_S)
-            | (src << _SRC_S)
-            | (dst << _DST_S)
-            | (rnd << _RND_S)
-            | (ldr << _LDR_S)
+            (kind << self._kind_s)
+            | (src << self._src_s)
+            | (dst << self._dst_s)
+            | (rnd << self._rnd_s)
+            | (ldr << self._ldr_s)
             | aux
         )
 
     def _decode_env(self, code: int) -> Envelope:
-        aux = code & ((1 << _AUX_B) - 1)
-        ldr = (code >> _LDR_S) & 3
-        rnd = (code >> _RND_S) & 7
-        dst = (code >> _DST_S) & 7
-        src = (code >> _SRC_S) & 7
-        kind = code >> _KIND_S
+        idm = (1 << self._id_b) - 1
+        aux = code & ((1 << self._aux_b) - 1)
+        ldr = (code >> self._ldr_s) & 3
+        rnd = (code >> self._rnd_s) & ((1 << self._rnd_b) - 1)
+        dst = (code >> self._dst_s) & idm
+        src = (code >> self._src_s) & idm
+        kind = code >> self._kind_s
         ballot = (rnd, Id(ldr))
         if kind == PUT:
             ci = src - S
@@ -226,7 +243,7 @@ class PaxosTensor(TensorModel):
         for s in range(S):
             a = st.actor_states[s]
             rnd, ldr = a.ballot
-            assert rnd < 8, a
+            assert rnd <= self.max_rnd, a
             vals[f"s{s}_rnd"] = rnd
             vals[f"s{s}_ldr"] = int(ldr)
             vals[f"s{s}_prop"] = (
@@ -390,12 +407,15 @@ class PaxosTensor(TensorModel):
         occupied = slots != u64(SLOT_EMPTY)
 
         # envelope fields per slot (= per action)  [B, A]
-        aux = (code & u64(63)).astype(i32)
-        ldr = ((code >> u64(_LDR_S)) & u64(3)).astype(i32)
-        rnd = ((code >> u64(_RND_S)) & u64(7)).astype(i32)
-        dst = ((code >> u64(_DST_S)) & u64(7)).astype(i32)
-        src = ((code >> u64(_SRC_S)) & u64(7)).astype(i32)
-        kind = (code >> u64(_KIND_S)).astype(i32)
+        idm = u64((1 << self._id_b) - 1)
+        aux = (code & u64((1 << self._aux_b) - 1)).astype(i32)
+        ldr = ((code >> u64(self._ldr_s)) & u64(3)).astype(i32)
+        rnd = (
+            (code >> u64(self._rnd_s)) & u64((1 << self._rnd_b) - 1)
+        ).astype(i32)
+        dst = ((code >> u64(self._dst_s)) & idm).astype(i32)
+        src = ((code >> u64(self._src_s)) & idm).astype(i32)
+        kind = (code >> u64(self._kind_s)).astype(i32)
         eb = rnd * 4 + ldr  # env ballot, lexicographic key
 
         def gi(name):  # packed field as [B, 1] int32 (broadcasts over A)
@@ -523,11 +543,11 @@ class PaxosTensor(TensorModel):
         def env_code(knd, esrc, edst, ernd, eldr, eaux):
             z = jnp.zeros_like(dst)
             return (
-                ((z + knd).astype(u64) << u64(_KIND_S))
-                | (esrc.astype(u64) << u64(_SRC_S))
-                | (edst.astype(u64) << u64(_DST_S))
-                | (ernd.astype(u64) << u64(_RND_S))
-                | (eldr.astype(u64) << u64(_LDR_S))
+                ((z + knd).astype(u64) << u64(self._kind_s))
+                | (esrc.astype(u64) << u64(self._src_s))
+                | (edst.astype(u64) << u64(self._dst_s))
+                | (ernd.astype(u64) << u64(self._rnd_s))
+                | (eldr.astype(u64) << u64(self._ldr_s))
                 | eaux.astype(u64)
             )
 
@@ -642,10 +662,10 @@ class PaxosTensor(TensorModel):
     def property_masks(self, rows):
         import jax.numpy as jnp
 
+        from ..parallel.history_tensor import closure_verdict
+
         C, pk = self.C, self.pk
         i32 = jnp.int32
-        po, rtW, rtR, exp = (jnp.asarray(t) for t in self._perm_tables)
-        P = po.shape[0]
         B = rows.shape[0]
 
         phase = jnp.stack(
@@ -659,62 +679,23 @@ class PaxosTensor(TensorModel):
         )
         hvalid = pk.get(rows, "hvalid") == jnp.uint64(1)
 
-        ok = jnp.ones((B, P), bool)
-        for c in range(C):
-            rreq = phase[:, c] == 2  # [B]
-            ok &= ~rreq[:, None] | po[None, :, c]
+        # s[b, i, t] = ops thread t had completed when thread i's read was
+        # invoked (the snapshot recorded at get-invocation; self slot 0)
+        done = phase == 2
+        s = jnp.zeros((B, C, C), i32)
+        for i in range(C):
             for t in range(C):
-                if t == c:
+                if t == i:
                     continue
-                s_ct = (snap[:, c] >> (2 * t)) & 3
-                ok &= ~(rreq & (s_ct >= 1))[:, None] | rtW[None, :, c, t]
-                ok &= ~(rreq & (s_ct == 2))[:, None] | rtR[None, :, c, t]
-            ok &= ~rreq[:, None] | (rval[:, c : c + 1] == exp[None, :, c])
-        linearizable = jnp.any(ok, axis=1) & hvalid
+                s = s.at[:, i, t].set((snap[:, i] >> (2 * t)) & 3)
+        linearizable = closure_verdict(done, s, rval) & hvalid
 
         # "value chosen": some get_ok with a non-null value is in flight
         slots = rows[:, self.pw :]
         code = slots >> jnp.uint64(COUNT_BITS)
         occ = slots != jnp.uint64(SLOT_EMPTY)
-        kind = (code >> jnp.uint64(_KIND_S)).astype(i32)
-        aux = (code & jnp.uint64(63)).astype(i32)
+        kind = (code >> jnp.uint64(self._kind_s)).astype(i32)
+        aux = (code & jnp.uint64((1 << self._aux_b) - 1)).astype(i32)
         chosen = jnp.any(occ & (kind == GET_OK) & (aux > 0), axis=-1)
 
         return jnp.stack([linearizable, chosen], axis=-1)
-
-
-def _perm_tables(C: int):
-    """Static validity tables over all permutations of the 2C operations.
-
-    Element 2c is thread c's write, 2c+1 its read.  Serializing an in-flight
-    op "not at all" is equivalent to placing it after every read, so plain
-    permutations cover the reference's include-or-skip choice for in-flight
-    ops (``linearizability.rs:183-200``).
-
-    Returns (po, rtW, rtR, exp):
-      po[p, c]     = write c precedes read c
-      rtW[p, c, t] = write t precedes read c    (real-time prerequisite)
-      rtR[p, c, t] = read t precedes read c
-      exp[p, c]    = value code read c must return (0 = NULL): the write with
-                     the greatest position before read c, if any
-    """
-    N = 2 * C
-    perms = list(permutations(range(N)))
-    P = len(perms)
-    pos = np.empty((P, N), np.int32)
-    for p, perm in enumerate(perms):
-        for position, elem in enumerate(perm):
-            pos[p, elem] = position
-    wpos = pos[:, 0::2]  # [P, C]
-    rpos = pos[:, 1::2]
-    po = wpos < rpos
-    rtW = wpos[:, None, :] < rpos[:, :, None]  # [P, c, t]
-    rtR = rpos[:, None, :] < rpos[:, :, None]
-    before = wpos[:, None, :] < rpos[:, :, None]  # write t before read c
-    masked = np.where(before, wpos[:, None, :], -1)
-    maxpos = masked.max(axis=2)  # [P, C]
-    exp = np.zeros((P, C), np.int32)
-    for t in range(C):
-        is_last = before[:, :, t] & (wpos[:, None, t] == maxpos)
-        exp = np.where(is_last, t + 1, exp)
-    return po, rtW, rtR, exp

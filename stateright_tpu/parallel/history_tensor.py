@@ -336,11 +336,11 @@ class LinHistoryCodec:
         """Closure-strategy verdict, computed per state on device.
 
         Each input is ``[..., C]`` int32 (the per-thread row fields); returns
-        ``[...]`` bool.  Builds the precedence graph over writes described in
-        the module docstring and tests it for cycles via ``log2(C)`` boolean
-        matrix squarings.  Exact for the plain-register workload; write-fail
-        workloads must use :meth:`device_lookup` (a failed write takes no
-        effect, which breaks the reads-dictate-writes reduction).
+        ``[...]`` bool.  Decodes this codec's packed snapshot fields into the
+        completion-count matrix and delegates to :func:`closure_verdict`.
+        Exact for the plain-register workload; write-fail workloads must use
+        :meth:`device_lookup` (a failed write takes no effect, which breaks
+        the reads-dictate-writes reduction).
         """
         import jax.numpy as jnp
 
@@ -352,8 +352,6 @@ class LinHistoryCodec:
         C = self.C
         batch = phases.shape[:-1]
         done = phases == PHASE_DONE  # [..., C] completed reads
-        null_read = jnp.any(done & (rvals == 0), axis=-1)
-        d = jnp.clip(rvals - 1, 0, C - 1)  # dictating writer per read
 
         # s[..., i, j] = ops thread j had completed when R_i was invoked
         s = jnp.zeros(batch + (C, C), jnp.int32)
@@ -363,29 +361,53 @@ class LinHistoryCodec:
                     continue
                 slot = self._snap_slot(i, j)
                 s = s.at[..., i, j].set((snaps[..., i] >> (2 * slot)) & 3)
+        return closure_verdict(done, s, rvals)
 
-        eye = jnp.eye(C, dtype=bool)
-        d_oh = eye[d]  # [..., C, C]: d_oh[..., i, :] = one-hot of d(i)
-        edges = jnp.zeros(batch + (C, C), bool)
-        for i in range(C):
-            di = d_oh[..., i, :]  # [..., C] target one-hot
-            gate = done[..., i, None, None]
-            # writes that must precede R_i: its own, plus every write
-            # completed before R_i's invocation -> edge k -> d(i)
-            pre = (s[..., i, :] >= 1) | eye[i]
-            edges = edges | (gate & pre[..., :, None] & di[..., None, :])
-            # reads completed before R_i's invocation: R_j < R_i forces
-            # window order -> edge d(j) -> d(i)
-            rr = (s[..., i, :] == 2) & done  # [..., C] over j
-            src = jnp.any(rr[..., :, None] & d_oh, axis=-2)  # [..., C]
-            edges = edges | (gate & src[..., :, None] & di[..., None, :])
-        edges = edges & ~eye  # k == d(i) cases are vacuous, not cycles
 
-        # transitive closure by squaring; cycle <=> any diagonal entry
-        reach = edges
-        for _ in range(max(1, (C - 1).bit_length())):
-            reach = reach | jnp.any(
-                reach[..., :, :, None] & reach[..., None, :, :], axis=-2
-            )
-        cycle = jnp.any(reach & eye, axis=(-2, -1))
-        return ~(null_read | cycle)
+def closure_verdict(done, s, rvals):
+    """Plain-register (put_count=1, unique values) linearizability verdict as
+    a write-precedence-graph acyclicity check — the core of the closure
+    strategy (see the module docstring for why the reduction is exact).
+
+    ``done``  [..., C] bool — thread i's read has completed;
+    ``s``     [..., C, C] int32 — ops thread j had completed when thread i's
+              read was invoked (diagonal ignored);
+    ``rvals`` [..., C] int32 — value index thread i's read returned
+              (0 = null/initial, 1.. = thread value), meaningful where done.
+    Returns [...] bool.  O(C^3 log C) vectorized boolean work per state; used
+    by both the mechanical compiler path (via :meth:`LinHistoryCodec.
+    device_verdict`) and the hand-tuned paxos twin
+    (``models/paxos_tensor.py``).
+    """
+    import jax.numpy as jnp
+
+    C = done.shape[-1]
+    batch = done.shape[:-1]
+    null_read = jnp.any(done & (rvals == 0), axis=-1)
+    d = jnp.clip(rvals - 1, 0, C - 1)  # dictating writer per read
+
+    eye = jnp.eye(C, dtype=bool)
+    d_oh = eye[d]  # [..., C, C]: d_oh[..., i, :] = one-hot of d(i)
+    edges = jnp.zeros(batch + (C, C), bool)
+    for i in range(C):
+        di = d_oh[..., i, :]  # [..., C] target one-hot
+        gate = done[..., i, None, None]
+        # writes that must precede R_i: its own, plus every write
+        # completed before R_i's invocation -> edge k -> d(i)
+        pre = (s[..., i, :] >= 1) | eye[i]
+        edges = edges | (gate & pre[..., :, None] & di[..., None, :])
+        # reads completed before R_i's invocation: R_j < R_i forces
+        # window order -> edge d(j) -> d(i)
+        rr = (s[..., i, :] == 2) & done  # [..., C] over j
+        src = jnp.any(rr[..., :, None] & d_oh, axis=-2)  # [..., C]
+        edges = edges | (gate & src[..., :, None] & di[..., None, :])
+    edges = edges & ~eye  # k == d(i) cases are vacuous, not cycles
+
+    # transitive closure by squaring; cycle <=> any diagonal entry
+    reach = edges
+    for _ in range(max(1, (C - 1).bit_length())):
+        reach = reach | jnp.any(
+            reach[..., :, :, None] & reach[..., None, :, :], axis=-2
+        )
+    cycle = jnp.any(reach & eye, axis=(-2, -1))
+    return ~(null_read | cycle)

@@ -131,13 +131,31 @@ def test_paxos_compiled_4_servers_matches_cpu():
 
 @pytest.mark.slow
 def test_paxos3_prefix_equivalence():
-    # C=3 exercises the 720-permutation linearizability table and the full
+    # C=3 exercises the closure linearizability verdict and the full
     # 2C-bit snapshot encoding; crawl_and_check validates property_masks
     # directly against prop.condition on real C=3 rows (the C=2 prefix test
-    # cannot reach C=3-specific encoding/table bugs).
+    # cannot reach C=3-specific encoding bugs).
     m = paxos_model(3, 3)
     tm = m.tensor_model()
     crawl_and_check(m, tm, max_levels=5)
+
+
+@pytest.mark.slow
+def test_paxos4_prefix_equivalence():
+    # C=4 is past the old (2C)! permutation cap: exercises the closure
+    # verdict and the C-parameterized field widths on real rows.
+    m = paxos_model(4, 3)
+    tm = m.tensor_model()
+    crawl_and_check(m, tm, max_levels=4)
+
+
+@pytest.mark.slow
+def test_paxos6_prefix_equivalence():
+    # the reference bench config (``paxos check 6``, bench.sh): a shallow
+    # crawl proving the widened encoding + closure verdict hold at C=6.
+    m = paxos_model(6, 3)
+    tm = m.tensor_model()
+    crawl_and_check(m, tm, max_levels=2)
 
 
 def test_paxos3_tpu_vs_cpu_sample():
