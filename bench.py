@@ -123,7 +123,48 @@ def cpu_phase() -> dict:
         lambda: TwoPhaseSys(6).checker().threads(threads).spawn_bfs()
     )
     out["cpu_2pc6_states_per_sec"] = round(cpu_t6.state_count() / dt6, 1)
+
+    # the reference's full bench protocol (bench.sh:27-34): 2pc 10, paxos 6,
+    # single-copy 4, lin-reg 2, lin-reg 3 ordered.  Python CPU BFS cannot
+    # finish the big ones in bench budget, so rate-like prefix runs are used
+    # (same treatment as paxos 3 above); each config is individually guarded.
+    for tag, build, target in _bench_protocol():
+        try:
+            c, dt = timed(
+                lambda: _capped(build().checker().threads(threads), target)
+                .spawn_bfs()
+            )
+            out[f"cpu_{tag}_states_per_sec"] = round(c.state_count() / dt, 1)
+            out[f"cpu_{tag}_unique"] = c.unique_state_count()
+        except Exception as e:  # noqa: BLE001 - secondary configs never void
+            out[f"cpu_{tag}_error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+def _capped(builder, target):
+    return builder.target_states(target) if target else builder
+
+
+def _bench_protocol():
+    """(tag, model builder, unique-state cap or None=full) for the reference
+    bench configs not already covered by the primary metrics."""
+    from stateright_tpu.models.linearizable_register import abd_model
+    from stateright_tpu.models.paxos import paxos_model
+    from stateright_tpu.models.single_copy_register import single_copy_model
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.actor import Network
+
+    return [
+        ("2pc10", lambda: TwoPhaseSys(10), 30_000),
+        ("paxos6", lambda: paxos_model(6), 20_000),
+        ("singlecopy4", lambda: single_copy_model(4, 1), 30_000),
+        ("linreg2", lambda: abd_model(2, 2), None),  # full: 544 unique
+        (
+            "linreg3_ordered",
+            lambda: abd_model(3, 2, Network.new_ordered()),
+            10_000,
+        ),
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -184,6 +225,30 @@ def tpu_phase() -> dict:
     _mark("paxos3 warm-up done")
     tpu_p3, dt = timed(spawn3)
     _mark("paxos3 timed run done")
+
+    # A/B the Pallas visited-set insert kernel (ops/pallas_insert.py) on the
+    # same primary config; count parity is asserted so a miscompiled kernel
+    # can't silently report a win.
+    try:
+        def spawn3p():
+            b = m3.checker()
+            if target:
+                b = b.target_states(int(target))
+            return b.spawn_tpu(sync=True, pallas=True, **caps)
+
+        spawn3p()  # warm-up (compile)
+        tpu_p3p, dtp = timed(spawn3p)
+        if tpu_p3p.unique_state_count() != tpu_p3.unique_state_count():
+            raise AssertionError(
+                f"pallas path unique {tpu_p3p.unique_state_count()} != "
+                f"{tpu_p3.unique_state_count()}"
+            )
+        out["tpu_paxos3_pallas_states_per_sec"] = round(
+            tpu_p3p.state_count() / dtp, 1
+        )
+        _mark("paxos3 pallas A/B done")
+    except Exception as e:  # noqa: BLE001
+        out["tpu_paxos3_pallas_error"] = f"{type(e).__name__}: {e}"
     out["tpu_paxos3_states_per_sec"] = round(tpu_p3.state_count() / dt, 1)
     out["tpu_paxos3_states"] = tpu_p3.state_count()
     out["tpu_paxos3_unique"] = tpu_p3.unique_state_count()
@@ -208,6 +273,26 @@ def tpu_phase() -> dict:
         out["tpu_2pc7_sec"] = round(dt7, 3)
     except Exception as e:  # noqa: BLE001
         out["tpu_2pc7_error"] = f"{type(e).__name__}: {e}"
+
+    # reference bench protocol on device (configs with a tensor twin); the
+    # lin-reg-3-ordered config has no twin (ordered networks are outside the
+    # compiled fragment) and records its TypeError instead.
+    for tag, build, target in _bench_protocol():
+        try:
+            if time.monotonic() - t_start > 0.75 * budget:
+                raise TimeoutError("phase budget mostly spent")
+            mm = build()
+            kw = dict(sync=True, capacity=1 << 21, queue_capacity=1 << 19,
+                      batch=2048)
+            _capped(mm.checker(), target).spawn_tpu(**kw)  # warm-up
+            c, dt = timed(
+                lambda: _capped(mm.checker(), target).spawn_tpu(**kw)
+            )
+            out[f"tpu_{tag}_states_per_sec"] = round(c.state_count() / dt, 1)
+            out[f"tpu_{tag}_unique"] = c.unique_state_count()
+            _mark(f"{tag} done")
+        except Exception as e:  # noqa: BLE001
+            out[f"tpu_{tag}_error"] = f"{type(e).__name__}: {e}"
 
     out["tpu_devices"] = _device_names()
     return out

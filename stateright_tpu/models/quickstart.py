@@ -1,0 +1,116 @@
+"""Executable quick-start examples — the package's "front page".
+
+The reference teaches its API through three doctest-sized specs: a sliding
+puzzle solved by the checker (``src/lib.rs:40-116``), Lamport logical clocks
+as a two-actor system (``src/actor.rs:11-78``), and a served toy model
+(``src/checker.rs:60-97``).  These are this package's equivalents, written
+as runnable functions (``python -m stateright_tpu.models.quickstart``) and
+executed by ``tests/test_quickstart.py`` so they double as specs here too.
+"""
+
+from __future__ import annotations
+
+from .. import Expectation, Property
+from ..actor import Actor, ActorModel, Id, Out
+from ..core import Model
+
+GOAL = (0, 1, 2, 3, 4, 5, 6, 7, 8)
+
+
+class SlidingPuzzle(Model):
+    """3×3 sliding puzzle: find a solve sequence with the BFS checker.
+
+    The *sometimes* property turns the checker into a solver: the discovery
+    trace for "solved" is a shortest move sequence (BFS order), exactly the
+    reference's front-page example (``src/lib.rs:40-116``).
+    """
+
+    def __init__(self, start=(1, 4, 2, 3, 5, 8, 6, 7, 0)):
+        super().__init__()
+        self.start = tuple(start)
+
+    def init_states(self):
+        return [self.start]
+
+    def actions(self, state):
+        return ["down", "up", "right", "left"]
+
+    def next_state(self, state, action):
+        empty = state.index(0)
+        ey, ex = divmod(empty, 3)
+        src = {
+            "down": empty - 3 if ey > 0 else None,   # tile above slides down
+            "up": empty + 3 if ey < 2 else None,     # tile below slides up
+            "right": empty - 1 if ex > 0 else None,  # tile left slides right
+            "left": empty + 1 if ex < 2 else None,   # tile right slides left
+        }[action]
+        if src is None:
+            return None
+        board = list(state)
+        board[empty], board[src] = board[src], 0
+        return tuple(board)
+
+    def properties(self):
+        return [Property.sometimes("solved", lambda m, s: s == GOAL)]
+
+
+class LogicalClock(Actor):
+    """Lamport-clock actor: each message carries a timestamp; receivers
+    advance past it and reply (``src/actor.rs:11-78`` behavior parity —
+    the checker finds how large the clocks can grow)."""
+
+    def __init__(self, bootstrap_to: Id | None = None):
+        self.bootstrap_to = bootstrap_to
+
+    def on_start(self, id: Id, out: Out):
+        if self.bootstrap_to is not None:
+            out.send(self.bootstrap_to, 1)
+            return 1
+        return 0
+
+    def on_msg(self, id: Id, state, src: Id, msg, out: Out):
+        if msg > state:
+            out.send(src, msg + 1)
+            return msg + 1
+        return None
+
+
+def solve_puzzle():
+    """Returns the shortest solve trace for the default puzzle."""
+    checker = SlidingPuzzle().checker().spawn_bfs().join()
+    checker.assert_properties()
+    return checker.discovery("solved")
+
+
+def clock_model(limit: int = 3) -> ActorModel:
+    m = ActorModel(cfg=None)
+    m.actor(LogicalClock())
+    m.actor(LogicalClock(bootstrap_to=Id(0)))
+    m.property(
+        Expectation.ALWAYS,
+        "less than max",
+        lambda model, s: all(ts < limit for ts in s.actor_states),
+    )
+    return m
+
+
+def clock_counterexample(limit: int = 3):
+    """Returns the trace on which a clock first reaches ``limit``."""
+    checker = clock_model(limit).checker().spawn_bfs().join()
+    return checker.discovery("less than max")
+
+
+def main() -> None:
+    path = solve_puzzle()
+    moves = path.actions()
+    print(f"puzzle solved in {len(moves)} moves:")
+    for step in moves:
+        print(f"  slide {step}")
+    trace = clock_counterexample()
+    n = len(trace.actions())
+    print(f"logical clocks exceed the bound after {n} deliveries;")
+    print(f"final clocks: {list(trace.final_state().actor_states)}")
+
+
+if __name__ == "__main__":
+    main()

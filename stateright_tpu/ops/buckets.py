@@ -55,6 +55,8 @@ def bucket_insert(
     fps: jnp.ndarray,  # uint64[M] candidates (EMPTY = invalid lane)
     payloads: jnp.ndarray,  # uint64[M]
     window: int,  # scatter chunk size (≈ expected novel per batch)
+    use_pallas: bool = False,  # write via the Pallas DMA kernel instead of
+    #                            windowed XLA scatters (ops/pallas_insert.py)
 ):
     """Insert all valid candidates; returns
     ``(table_fp, table_payload, counts, order, perm, novel, n_new, overflow)``.
@@ -106,6 +108,20 @@ def bucket_insert(
     tgt = jnp.where(novel, bucket * SLOTS + slot, nslots)[perm]
     cfp = sfp[perm]
     cpl = payloads[order][perm]
+
+    if use_pallas:
+        from .pallas_insert import pallas_scatter_insert
+
+        # on overflow nothing may be written (parity with the XLA path)
+        n_eff = jnp.where(overflow, 0, n_new)
+        table_fp, table_payload, counts = pallas_scatter_insert(
+            table_fp, table_payload, counts, tgt, cfp, cpl, n_eff
+        )
+        return (
+            table_fp, table_payload, counts, order, perm, novel, n_new,
+            overflow,
+        )
+
     # Pad to a whole number of windows: ``dynamic_slice`` clamps its start
     # index, which would silently misalign the final chunk against its
     # ``in_range`` mask (dropping the last novel entries).

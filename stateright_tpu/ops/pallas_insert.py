@@ -1,0 +1,249 @@
+"""Pallas TPU kernel for the visited-set insert (the north-star hot op).
+
+Drop-in replacement for the two windowed-scatter ``while_loop``s in
+``ops/buckets.bucket_insert`` (reference analogue: the lock-striped
+``DashMap`` insert, ``src/checker/bfs.rs:26``).  The XLA path expresses the
+insert as chunked ``scatter``s, which XLA lowers to (effectively
+index-serial) HBM updates plus a full table copy unless donation kicks in.
+This kernel instead walks the *bucket-sorted* novel candidates once,
+streaming each touched 128-slot line group HBM→VMEM→HBM with explicit DMA:
+
+ - the tables stay in HBM (``pl.ANY``) and are updated **in place** via
+   ``input_output_aliases`` — no table-sized copies, no scatter lowering;
+ - candidates arrive bucket-sorted (the engine already sorts for dedup), so
+   each line group is fetched and flushed exactly once per insert;
+ - per candidate the update is a 256-lane masked select on the VPU;
+ - the trip count is the *dynamic* novel count — padding lanes cost nothing
+   (no DMA, no flush), so one compiled kernel serves every batch.
+
+``uint64`` is not a native Pallas/TPU dtype, so the wrapper bitcasts the
+u64 tables and candidate words to pairs of u32 lanes (little-endian: lane
+``2k`` = low word of slot ``k``).
+
+Correctness contract (same as the XLA scatters): target slots are distinct
+(bucket * SLOTS + per-bucket rank), candidates are pre-deduplicated and
+pre-screened for membership, and the counts update is last-writer-wins
+within a bucket (ranks increase within a bucket, so the final ``slot+1``
+is the new occupancy).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .buckets import SLOTS
+
+# one line group = 8 buckets x 16 slots = 128 u64 slots = 256 u32 lanes
+GROUP_BUCKETS = 8
+GROUP_SLOTS = GROUP_BUCKETS * SLOTS
+GROUP_LANES = 2 * GROUP_SLOTS  # u32 lanes per group
+
+# counts are grouped 256 buckets per line (u32 lanes)
+CNT_GROUP = 256
+
+
+def _insert_kernel(
+    n_ref,  # SMEM (1,) i32: novel count
+    meta_ref,  # VMEM [T, 8] i32: group, lane, fplo, fphi, pllo, plhi,
+    #            cgroup, clane   (bucket-sorted, padded with group=-1)
+    cval_ref,  # VMEM [T, 1] i32: new bucket occupancy (slot + 1)
+    tfp_hbm,  # ANY  [ngroups, GROUP_LANES] u32 (aliased out 0)
+    tpl_hbm,  # ANY  [ngroups, GROUP_LANES] u32 (aliased out 1)
+    cnt_hbm,  # ANY  [cgroups, CNT_GROUP] u32 (aliased out 2)
+    tfp_out,
+    tpl_out,
+    cnt_out,
+    fp_line,  # VMEM scratch (1, GROUP_LANES) u32
+    pl_line,
+    cnt_line,  # VMEM scratch (1, CNT_GROUP) u32
+    sem,  # DMA semaphores (6,)
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = n_ref[0]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, GROUP_LANES), 1)
+    clanes = jax.lax.broadcasted_iota(jnp.int32, (1, CNT_GROUP), 1)
+
+    def fetch(g, cg):
+        cp = pltpu.make_async_copy(tfp_out.at[pl.ds(g, 1)], fp_line, sem.at[0])
+        cp.start()
+        cp2 = pltpu.make_async_copy(tpl_out.at[pl.ds(g, 1)], pl_line, sem.at[1])
+        cp2.start()
+        cp3 = pltpu.make_async_copy(cnt_out.at[pl.ds(cg, 1)], cnt_line, sem.at[2])
+        cp3.start()
+        cp.wait()
+        cp2.wait()
+        cp3.wait()
+
+    def flush(g, cg):
+        cp = pltpu.make_async_copy(fp_line, tfp_out.at[pl.ds(g, 1)], sem.at[3])
+        cp.start()
+        cp2 = pltpu.make_async_copy(pl_line, tpl_out.at[pl.ds(g, 1)], sem.at[4])
+        cp2.start()
+        cp3 = pltpu.make_async_copy(cnt_line, cnt_out.at[pl.ds(cg, 1)], sem.at[5])
+        cp3.start()
+        cp.wait()
+        cp2.wait()
+        cp3.wait()
+
+    def body(j, carry):
+        cur_g, cur_cg = carry
+        g = meta_ref[j, 0]
+        lane = meta_ref[j, 1]
+        cg = meta_ref[j, 6]
+        clane = meta_ref[j, 7]
+
+        @pl.when(g != cur_g)
+        def _():
+            @pl.when(cur_g >= 0)
+            def _():
+                flush(cur_g, cur_cg)
+
+            fetch(g, cg)
+
+        lo = jnp.full((1, GROUP_LANES), 0, jnp.int32) + meta_ref[j, 2]
+        hi = jnp.full((1, GROUP_LANES), 0, jnp.int32) + meta_ref[j, 3]
+        plo = jnp.full((1, GROUP_LANES), 0, jnp.int32) + meta_ref[j, 4]
+        phi = jnp.full((1, GROUP_LANES), 0, jnp.int32) + meta_ref[j, 5]
+        sel_lo = lanes == 2 * lane
+        sel_hi = lanes == 2 * lane + 1
+        fp_line[:, :] = jnp.where(
+            sel_lo, lo.astype(jnp.uint32),
+            jnp.where(sel_hi, hi.astype(jnp.uint32), fp_line[:, :]),
+        )
+        pl_line[:, :] = jnp.where(
+            sel_lo, plo.astype(jnp.uint32),
+            jnp.where(sel_hi, phi.astype(jnp.uint32), pl_line[:, :]),
+        )
+        cnt_line[:, :] = jnp.where(
+            clanes == clane,
+            jnp.full((1, CNT_GROUP), 0, jnp.uint32)
+            + cval_ref[j, 0].astype(jnp.uint32),
+            cnt_line[:, :],
+        )
+        return g, cg
+
+    last_g, last_cg = jax.lax.fori_loop(
+        0, n, body, (jnp.int32(-1), jnp.int32(-1))
+    )
+
+    @pl.when(last_g >= 0)
+    def _():
+        flush(last_g, last_cg)
+
+
+def pallas_scatter_insert(
+    table_fp,  # u64 [nslots]
+    table_payload,  # u64 [nslots]
+    counts,  # u32 [nbuckets]
+    tgt,  # i32 [M] target slot per sorted candidate (nslots = invalid/pad)
+    cfp,  # u64 [M] fingerprints, bucket-sorted, novel-compacted
+    cpl,  # u64 [M]
+    n_new,  # i32 scalar: number of valid candidates (prefix of the arrays)
+):
+    """Write ``cfp/cpl`` to ``tgt`` slots and refresh bucket counts, as one
+    Pallas kernel invocation.  Equivalent to (and validated against) the
+    windowed-scatter path in :func:`ops.buckets.bucket_insert`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nslots = table_fp.shape[0]
+    nbuckets = counts.shape[0]
+    # pad tiny tables up to one whole line group / count group (larger-than-
+    # one-group tables are already powers of two, hence multiples); padding
+    # copies, but only on toy sizes — engine-scale tables alias in place
+    spad = (-nslots) % GROUP_SLOTS
+    cpad = (-nbuckets) % CNT_GROUP
+    if spad:
+        table_fp = jnp.concatenate(
+            [table_fp, jnp.zeros((spad,), jnp.uint64)]
+        )
+        table_payload = jnp.concatenate(
+            [table_payload, jnp.zeros((spad,), jnp.uint64)]
+        )
+    if cpad:
+        counts = jnp.concatenate([counts, jnp.zeros((cpad,), jnp.uint32)])
+    ngroups = table_fp.shape[0] // GROUP_SLOTS
+    cgroups = counts.shape[0] // CNT_GROUP
+    m = tgt.shape[0]
+
+    # -- vector-side prep (cheap XLA) --------------------------------------
+    valid = tgt < nslots
+    slot = jnp.minimum(tgt, nslots - 1)
+    bucket = slot // SLOTS
+    g = slot // GROUP_SLOTS
+    lane = slot - g * GROUP_SLOTS
+    cg = bucket // CNT_GROUP
+    clane = bucket - cg * CNT_GROUP
+    f32 = jax.lax.bitcast_convert_type(cfp, jnp.uint32).astype(jnp.int32)
+    p32 = jax.lax.bitcast_convert_type(cpl, jnp.uint32).astype(jnp.int32)
+    meta = jnp.stack(
+        [
+            jnp.where(valid, g, -1),
+            lane,
+            f32[:, 0],
+            f32[:, 1],
+            p32[:, 0],
+            p32[:, 1],
+            cg,
+            clane,
+        ],
+        axis=1,
+    ).astype(jnp.int32)
+    cval = ((slot - bucket * SLOTS) + 1).astype(jnp.int32)[:, None]
+
+    tfp32 = jax.lax.bitcast_convert_type(table_fp, jnp.uint32).reshape(
+        ngroups, GROUP_LANES
+    )
+    tpl32 = jax.lax.bitcast_convert_type(table_payload, jnp.uint32).reshape(
+        ngroups, GROUP_LANES
+    )
+    cnt2 = counts.reshape(cgroups, CNT_GROUP)
+
+    interpret = jax.default_backend() != "tpu"
+    out_fp, out_pl, out_cnt = pl.pallas_call(
+        _insert_kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(tfp32.shape, jnp.uint32),
+            jax.ShapeDtypeStruct(tpl32.shape, jnp.uint32),
+            jax.ShapeDtypeStruct(cnt2.shape, jnp.uint32),
+        ],
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, GROUP_LANES), jnp.uint32),
+            pltpu.VMEM((1, GROUP_LANES), jnp.uint32),
+            pltpu.VMEM((1, CNT_GROUP), jnp.uint32),
+            pltpu.SemaphoreType.DMA((6,)),
+        ],
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=interpret,
+    )(
+        n_new.reshape(1).astype(jnp.int32),
+        meta,
+        cval,
+        tfp32,
+        tpl32,
+        cnt2,
+    )
+    padded = nslots + spad
+    table_fp = jax.lax.bitcast_convert_type(
+        out_fp.reshape(padded, 2), jnp.uint64
+    ).reshape(padded)[:nslots]
+    table_payload = jax.lax.bitcast_convert_type(
+        out_pl.reshape(padded, 2), jnp.uint64
+    ).reshape(padded)[:nslots]
+    return table_fp, table_payload, out_cnt.reshape(-1)[:nbuckets]

@@ -328,7 +328,13 @@ class ShardedTpuChecker(WavefrontChecker):
         frontier_capacity: int = 1 << 13,
         bucket_factor: int = 2,
         sync: bool = False,
+        pallas: Optional[bool] = None,
     ):
+        if pallas:
+            raise NotImplementedError(
+                "the Pallas insert kernel is single-device only for now; "
+                "drop pallas=True or use spawn_tpu() without devices/mesh"
+            )
         self.mesh = mesh if mesh is not None else default_mesh(n_devices)
         self.ndev = self.mesh.shape[AXIS]
         # capacities are global; divide into power-of-two per-device shards

@@ -94,7 +94,7 @@ def _stats_np(carry) -> np.ndarray:
 
 
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
-                  steps: int, target: Optional[int]):
+                  steps: int, target: Optional[int], pallas: bool = False):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -178,7 +178,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         ).reshape(-1)
 
         tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
-            tfp, tpl, cnt, cand_fp, cand_par, window=batch
+            tfp, tpl, cnt, cand_fp, cand_par, window=batch, use_pallas=pallas
         )
         # Append novel rows (compacted to the perm front) at the queue tail.
         # Rows past ``n_new`` in the written window are garbage; they sit in
@@ -252,7 +252,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
             tfp, tpl, cnt, ifp,
             jnp.zeros((n_init,), jnp.uint64),  # parent 0 = "is an init state"
-            window=n_init,
+            window=n_init, use_pallas=pallas,
         )
         sel = order[perm]
         qrows = jax.lax.dynamic_update_slice(
@@ -310,6 +310,12 @@ class TpuChecker(WavefrontChecker):
     ``steps_per_call`` — device steps per host round-trip: the host syncs
     this often to refresh live counters and serve checkpoint requests.
     ``resume`` — a snapshot from :meth:`checkpoint` to continue from.
+    ``pallas`` — use the Pallas DMA insert kernel for the visited set
+    (``ops/pallas_insert.py``); default is the env knob
+    ``STATERIGHT_TPU_PALLAS=1`` (off otherwise — the XLA windowed-scatter
+    path remains the portable default until the kernel wins on hardware).
+    Single-device engine only: the sharded engine has its own insert and
+    rejects ``pallas=True``.
     """
 
     def __init__(
@@ -322,8 +328,14 @@ class TpuChecker(WavefrontChecker):
         steps_per_call: int = 64,
         sync: bool = False,
         resume: Optional[dict] = None,
+        pallas: Optional[bool] = None,
     ):
+        import os
+
         self._cap = max(_pow2(capacity), 4 * SLOTS)
+        if pallas is None:
+            pallas = os.environ.get("STATERIGHT_TPU_PALLAS", "") == "1"
+        self._pallas = bool(pallas)
         if batch is None:
             batch = frontier_capacity if frontier_capacity else 1 << 11
         self._batch = max(8, batch)
@@ -345,12 +357,12 @@ class TpuChecker(WavefrontChecker):
         if cache is None:
             cache = {}
             self.tensor._run_cache = cache
-        key = (cap, qcap, batch, self._steps, self._target)
+        key = (cap, qcap, batch, self._steps, self._target, self._pallas)
         eng = cache.get(key)
         if eng is None:
             eng = _build_engine(
                 self.tensor, self._props, cap, qcap, batch, self._steps,
-                self._target,
+                self._target, pallas=self._pallas,
             )
             cache[key] = eng
         return eng

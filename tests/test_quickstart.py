@@ -1,0 +1,40 @@
+"""The quick-start examples are executable specs (reference doctest parity:
+``src/lib.rs:40-116`` sliding puzzle, ``src/actor.rs:11-78`` logical
+clocks)."""
+
+from stateright_tpu.models.quickstart import (
+    GOAL,
+    SlidingPuzzle,
+    clock_counterexample,
+    clock_model,
+    solve_puzzle,
+)
+
+
+def test_puzzle_solved_shortest():
+    path = solve_puzzle()
+    # BFS discovery is a shortest solve; the reference's pinned solution is
+    # 4 moves (lib.rs:96-116)
+    assert path.actions() == ["down", "right", "down", "right"]
+    assert path.final_state() == GOAL
+
+
+def test_puzzle_assert_discovery():
+    checker = SlidingPuzzle().checker().spawn_bfs().join()
+    checker.assert_discovery("solved", ["down", "right", "down", "right"])
+
+
+def test_clock_counterexample():
+    trace = clock_counterexample()
+    # reference pins the 2-delivery counterexample with clocks (2, 3)
+    assert len(trace.actions()) == 2
+    assert tuple(trace.final_state().actor_states) == (2, 3)
+
+
+def test_clock_dfs_agrees():
+    bfs = clock_model().checker().spawn_bfs().join()
+    dfs = clock_model().checker().spawn_dfs().join()
+    assert (
+        "less than max" in bfs.discoveries()
+        and "less than max" in dfs.discoveries()
+    )
