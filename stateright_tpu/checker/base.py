@@ -28,6 +28,7 @@ class CheckerBuilder:
     def __init__(self, model: Model):
         self.model = model
         self.symmetry_fn: Optional[Callable] = None
+        self.symmetry_is_default = False
         self.target_state_count: Optional[int] = None
         self.thread_count: int = 1
         self.visitor_obj: Optional[CheckerVisitor] = None
@@ -39,10 +40,12 @@ class CheckerBuilder:
         """Dedupe on symmetry-class representatives; states must define
         ``representative()`` (reference ``checker.rs:150-154``)."""
         self.symmetry_fn = lambda s: s.representative()
+        self.symmetry_is_default = True
         return self
 
     def symmetry_with(self, fn: Callable) -> "CheckerBuilder":
         self.symmetry_fn = fn
+        self.symmetry_is_default = False
         return self
 
     def target_states(self, count: int) -> "CheckerBuilder":

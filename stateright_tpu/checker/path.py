@@ -38,34 +38,89 @@ class Path(Generic[State, Action]):
     # -- construction --------------------------------------------------------
 
     @staticmethod
-    def from_fingerprints(model, fingerprints: Sequence[int]) -> "Path":
+    def from_fingerprints(model, fingerprints: Sequence[int], key=None) -> "Path":
         """Re-execute ``model`` along a fingerprint trace
-        (reference ``path.rs:20-86``)."""
+        (reference ``path.rs:20-86``).
+
+        ``key`` overrides the per-state fingerprint used for matching —
+        symmetry-reduced device runs pass the *canonical* fingerprint
+        (``fingerprint_state(representative(s))``), so the walk picks, at
+        each step, an actual successor of the previously chosen member whose
+        symmetry class matches the trace.  The result is a genuine path of
+        the model."""
         if not fingerprints:
             raise ValueError("empty fingerprint path")
         fps = list(fingerprints)
-        init_fp = fps[0]
-        state = None
-        for s in model.init_states():
-            if model.fingerprint_state(s) == init_fp:
-                state = s
-                break
-        if state is None:
-            raise RuntimeError(_NONDETERMINISM_MSG.format(fp=init_fp, n=0))
-        pairs: list[tuple[State, Optional[Action]]] = []
-        for i, want in enumerate(fps[1:], start=1):
-            found = None
+        if key is None:
+            # exact fingerprints are injective along the trace: the greedy
+            # first-match walk is exhaustive, no backtracking needed
+            key = model.fingerprint_state
+            greedy = True
+        else:
+            # a symmetry key maps whole classes to one fingerprint, and the
+            # representative need not be class-invariant — committing to the
+            # wrong member can dead-end even though the trace is valid, so
+            # the walk backtracks over matching members
+            greedy = False
+
+        def matches(state, want):
+            out = []
+            seen_members = set()
             for action in model.actions(state):
                 nxt = model.next_state(state, action)
-                if nxt is not None and model.fingerprint_state(nxt) == want:
-                    found = (action, nxt)
-                    break
-            if found is None:
-                raise RuntimeError(_NONDETERMINISM_MSG.format(fp=want, n=i - 1))
-            pairs.append((state, found[0]))
-            state = found[1]
-        pairs.append((state, None))
-        return Path(pairs)
+                if nxt is not None and key(nxt) == want:
+                    if greedy:
+                        return [(action, nxt)]
+                    # distinct actions often produce the identical successor;
+                    # keep one per member or backtracking re-explores the
+                    # same dead-end subtree per duplicate
+                    member = model.fingerprint_state(nxt)
+                    if member not in seen_members:
+                        seen_members.add(member)
+                        out.append((action, nxt))
+            return out
+
+        init_matches = [
+            (None, s) for s in model.init_states() if key(s) == fps[0]
+        ]
+        if not init_matches:
+            raise RuntimeError(_NONDETERMINISM_MSG.format(fp=fps[0], n=0))
+        # DFS over (depth, chosen member) with explicit alternatives stack
+        stack = [(0, init_matches)]  # depth i: candidates matching fps[i]
+        chosen: list[tuple[Optional[Action], State]] = []
+        deepest = 0  # deepest matched depth, for the failure diagnostic
+        while stack:
+            depth, cands = stack[-1]
+            if not cands:
+                stack.pop()
+                if chosen:
+                    chosen.pop()
+                continue
+            act_nxt = cands.pop(0)
+            chosen.append(act_nxt)
+            deepest = max(deepest, depth)
+            if depth + 1 == len(fps):
+                pairs: list[tuple[State, Optional[Action]]] = []
+                for i in range(len(chosen) - 1):
+                    pairs.append((chosen[i][1], chosen[i + 1][0]))
+                pairs.append((chosen[-1][1], None))
+                return Path(pairs)
+            nxt_cands = matches(act_nxt[1], fps[depth + 1])
+            if nxt_cands:
+                stack.append((depth + 1, nxt_cands))
+            else:
+                chosen.pop()
+        if not greedy:
+            raise RuntimeError(
+                "Failed to reconstruct a symmetry-reduced path: no sequence "
+                "of class members matches the recorded canonical "
+                f"fingerprints (failed past step {deepest} of {len(fps)}). "
+                "This indicates the model's representative() disagrees with "
+                "the device canonicalizer, or the model is nondeterministic."
+            )
+        raise RuntimeError(
+            _NONDETERMINISM_MSG.format(fp=fps[deepest + 1], n=deepest)
+        )
 
     @staticmethod
     def from_actions(

@@ -238,6 +238,48 @@ class TwoPhaseTensor(TensorModel):
         rows = [self.encode_state(s) for s in self.model.init_states()]
         return np.asarray(rows, dtype=np.uint64)
 
+    def representative_rows(self, rows):
+        """Vectorized symmetry canonicalizer: the device analogue of
+        :meth:`TwoPhaseState.representative` (stable sort of RM sub-states,
+        reindexing ``tm_prepared`` and the ``prepared`` message bits by the
+        same permutation).  Must replicate the object form *exactly* — the
+        host sorts the RM state **strings** ("aborted" < "committed" <
+        "prepared" < "working"), which is the reverse of the 2-bit codes, so
+        the device sort key is ``3 - code``; stable argsort then yields the
+        identical permutation, preserving the pinned symmetry counts
+        (665 @ 5 RMs, reference ``2pc.rs:138``)."""
+        import jax.numpy as jnp
+
+        n, pk = self.n, self.packer
+        u64 = jnp.uint64
+        rm = pk.get(rows, "rm")
+        tp = pk.get(rows, "tm_prepared")
+        mp = pk.get(rows, "msg_prepared")
+        rmv = jnp.stack(
+            [((rm >> u64(2 * i)) & u64(3)).astype(jnp.int32) for i in range(n)],
+            -1,
+        )  # [..., n]
+        tpv = jnp.stack(
+            [((tp >> u64(i)) & u64(1)).astype(jnp.int32) for i in range(n)], -1
+        )
+        mpv = jnp.stack(
+            [((mp >> u64(i)) & u64(1)).astype(jnp.int32) for i in range(n)], -1
+        )
+        order = jnp.argsort(3 - rmv, axis=-1, stable=True)  # new -> old
+        rms = jnp.take_along_axis(rmv, order, axis=-1)
+        tps = jnp.take_along_axis(tpv, order, axis=-1)
+        mps = jnp.take_along_axis(mpv, order, axis=-1)
+        zero = jnp.zeros_like(rm)
+        rm_new, tp_new, mp_new = zero, zero, zero
+        for i in range(n):
+            rm_new = rm_new | (rms[..., i].astype(u64) << u64(2 * i))
+            tp_new = tp_new | (tps[..., i].astype(u64) << u64(i))
+            mp_new = mp_new | (mps[..., i].astype(u64) << u64(i))
+        rows = pk.set(rows, "rm", rm_new)
+        rows = pk.set(rows, "tm_prepared", tp_new)
+        rows = pk.set(rows, "msg_prepared", mp_new)
+        return rows
+
     # -- device --------------------------------------------------------------
 
     def step_rows(self, rows):

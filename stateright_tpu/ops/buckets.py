@@ -57,6 +57,8 @@ def bucket_insert(
     window: int,  # scatter chunk size (≈ expected novel per batch)
     use_pallas: bool = False,  # write via the Pallas DMA kernel instead of
     #                            windowed XLA scatters (ops/pallas_insert.py)
+    generation_order: bool = False,  # compact novel rows in generation order
+    #                            (needed for symmetry runs; see below)
 ):
     """Insert all valid candidates; returns
     ``(table_fp, table_payload, counts, order, perm, novel, n_new, overflow)``.
@@ -101,26 +103,23 @@ def bucket_insert(
     overflow = jnp.any(novel & (slot >= SLOTS))
     n_new = jnp.sum(novel).astype(jnp.int32)
 
-    # compact novel candidates to the front; windowed chunked scatters write
-    # only ~n_new entries (scatter cost on TPU scales with index count)
-    keys = jnp.where(novel, idx, jnp.int32(m))
+    # Compact novel candidates to the front.  Plain runs keep sorted-fp
+    # order (bucket-contiguous — the Pallas kernel then touches each line
+    # group once); the visited SET is order-independent there.  Symmetry
+    # runs compact in GENERATION order (original batch position): the dedup
+    # key is the canonical fp of a not-necessarily-class-invariant
+    # representative, so enqueue order decides which class member gets
+    # explored — generation order makes the reduced search reproducible by
+    # a host FIFO oracle (tests/test_tensor_models.py).  Windowed chunked
+    # scatters write only ~n_new entries either way.
+    if generation_order:
+        keys = jnp.where(novel, order.astype(jnp.int32), jnp.int32(m))
+    else:
+        keys = jnp.where(novel, idx, jnp.int32(m))
     perm = jnp.argsort(keys)
     tgt = jnp.where(novel, bucket * SLOTS + slot, nslots)[perm]
     cfp = sfp[perm]
     cpl = payloads[order][perm]
-
-    if use_pallas:
-        from .pallas_insert import pallas_scatter_insert
-
-        # on overflow nothing may be written (parity with the XLA path)
-        n_eff = jnp.where(overflow, 0, n_new)
-        table_fp, table_payload, counts = pallas_scatter_insert(
-            table_fp, table_payload, counts, tgt, cfp, cpl, n_eff
-        )
-        return (
-            table_fp, table_payload, counts, order, perm, novel, n_new,
-            overflow,
-        )
 
     # Pad to a whole number of windows: ``dynamic_slice`` clamps its start
     # index, which would silently misalign the final chunk against its
@@ -132,29 +131,38 @@ def bucket_insert(
             return x
         return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
 
-    tgt = padded(tgt, nslots)
-    cfp = padded(cfp, EMPTY)
-    cpl = padded(cpl, 0)
-
     def chunk_cond(state):
         k, *_ = state
         return (k * window < n_new) & ~overflow
 
-    def chunk_body(state):
-        k, tfp, tpl = state
-        off = k * window
-        t = jax.lax.dynamic_slice(tgt, (off,), (window,))
-        f = jax.lax.dynamic_slice(cfp, (off,), (window,))
-        p = jax.lax.dynamic_slice(cpl, (off,), (window,))
-        in_range = jnp.arange(window, dtype=jnp.int32) + off < n_new
-        t = jnp.where(in_range, t, nslots)
-        tfp = tfp.at[t].set(f, mode="drop")
-        tpl = tpl.at[t].set(p, mode="drop")
-        return k + 1, tfp, tpl
+    if use_pallas:
+        from .pallas_insert import pallas_scatter_insert
 
-    _, table_fp, table_payload = jax.lax.while_loop(
-        chunk_cond, chunk_body, (jnp.int32(0), table_fp, table_payload)
-    )
+        # on overflow nothing may be written (parity with the XLA path)
+        n_eff = jnp.where(overflow, 0, n_new)
+        table_fp, table_payload = pallas_scatter_insert(
+            table_fp, table_payload, tgt, cfp, cpl, n_eff
+        )
+    else:
+        ptgt = padded(tgt, nslots)
+        pcfp = padded(cfp, EMPTY)
+        pcpl = padded(cpl, 0)
+
+        def chunk_body(state):
+            k, tfp, tpl = state
+            off = k * window
+            t = jax.lax.dynamic_slice(ptgt, (off,), (window,))
+            f = jax.lax.dynamic_slice(pcfp, (off,), (window,))
+            p = jax.lax.dynamic_slice(pcpl, (off,), (window,))
+            in_range = jnp.arange(window, dtype=jnp.int32) + off < n_new
+            t = jnp.where(in_range, t, nslots)
+            tfp = tfp.at[t].set(f, mode="drop")
+            tpl = tpl.at[t].set(p, mode="drop")
+            return k + 1, tfp, tpl
+
+        _, table_fp, table_payload = jax.lax.while_loop(
+            chunk_cond, chunk_body, (jnp.int32(0), table_fp, table_payload)
+        )
 
     # occupancy update: scatter final count from each bucket's last novel row
     new_count = (slot + 1).astype(jnp.uint32)

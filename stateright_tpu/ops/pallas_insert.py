@@ -1,18 +1,20 @@
 """Pallas TPU kernel for the visited-set insert (the north-star hot op).
 
-Drop-in replacement for the two windowed-scatter ``while_loop``s in
+Drop-in replacement for the fp/payload windowed-scatter ``while_loop`` in
 ``ops/buckets.bucket_insert`` (reference analogue: the lock-striped
 ``DashMap`` insert, ``src/checker/bfs.rs:26``).  The XLA path expresses the
 insert as chunked ``scatter``s, which XLA lowers to (effectively
 index-serial) HBM updates plus a full table copy unless donation kicks in.
-This kernel instead walks the *bucket-sorted* novel candidates once,
-streaming each touched 128-slot line group HBM→VMEM→HBM with explicit DMA:
+This kernel instead walks the novel candidates once, streaming each touched
+128-slot line group HBM→VMEM→HBM with explicit DMA:
 
  - the tables stay in HBM (``pl.ANY``) and are updated **in place** via
    ``input_output_aliases`` — no table-sized copies, no scatter lowering;
- - candidates arrive bucket-sorted (the engine already sorts for dedup), so
-   each line group is fetched and flushed exactly once per insert;
- - per candidate the update is a 256-lane masked select on the VPU;
+ - per candidate the update is a 256-lane masked select on the VPU; a line
+   group is flushed/re-fetched only when the walk crosses a group boundary
+   (candidates arrive in generation order — often bucket-clustered but not
+   sorted — and re-fetching a previously flushed group reads its updated
+   content, so ordering affects only DMA count, never correctness);
  - the trip count is the *dynamic* novel count — padding lanes cost nothing
    (no DMA, no flush), so one compiled kernel serves every batch.
 
@@ -20,11 +22,15 @@ streaming each touched 128-slot line group HBM→VMEM→HBM with explicit DMA:
 u64 tables and candidate words to pairs of u32 lanes (little-endian: lane
 ``2k`` = low word of slot ``k``).
 
+Bucket occupancy counts stay on the XLA windowed-scatter path in
+``bucket_insert``: exactly one row per bucket (the max-rank novel row)
+carries a real count target, so that scatter is write-order-independent and
+tiny, while the u64 fp/payload writes — the HBM-bandwidth cost — go through
+this kernel.
+
 Correctness contract (same as the XLA scatters): target slots are distinct
-(bucket * SLOTS + per-bucket rank), candidates are pre-deduplicated and
-pre-screened for membership, and the counts update is last-writer-wins
-within a bucket (ranks increase within a bucket, so the final ``slot+1``
-is the new occupancy).
+(bucket * SLOTS + per-bucket rank) and candidates are pre-deduplicated and
+pre-screened for membership.
 """
 
 from __future__ import annotations
@@ -39,69 +45,51 @@ GROUP_BUCKETS = 8
 GROUP_SLOTS = GROUP_BUCKETS * SLOTS
 GROUP_LANES = 2 * GROUP_SLOTS  # u32 lanes per group
 
-# counts are grouped 256 buckets per line (u32 lanes)
-CNT_GROUP = 256
-
 
 def _insert_kernel(
     n_ref,  # SMEM (1,) i32: novel count
-    meta_ref,  # VMEM [T, 8] i32: group, lane, fplo, fphi, pllo, plhi,
-    #            cgroup, clane   (bucket-sorted, padded with group=-1)
-    cval_ref,  # VMEM [T, 1] i32: new bucket occupancy (slot + 1)
+    meta_ref,  # VMEM [T, 8] i32: group, lane, fplo, fphi, pllo, plhi, 0, 0
     tfp_hbm,  # ANY  [ngroups, GROUP_LANES] u32 (aliased out 0)
     tpl_hbm,  # ANY  [ngroups, GROUP_LANES] u32 (aliased out 1)
-    cnt_hbm,  # ANY  [cgroups, CNT_GROUP] u32 (aliased out 2)
     tfp_out,
     tpl_out,
-    cnt_out,
     fp_line,  # VMEM scratch (1, GROUP_LANES) u32
     pl_line,
-    cnt_line,  # VMEM scratch (1, CNT_GROUP) u32
-    sem,  # DMA semaphores (6,)
+    sem,  # DMA semaphores (4,)
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = n_ref[0]
     lanes = jax.lax.broadcasted_iota(jnp.int32, (1, GROUP_LANES), 1)
-    clanes = jax.lax.broadcasted_iota(jnp.int32, (1, CNT_GROUP), 1)
 
-    def fetch(g, cg):
+    def fetch(g):
         cp = pltpu.make_async_copy(tfp_out.at[pl.ds(g, 1)], fp_line, sem.at[0])
         cp.start()
         cp2 = pltpu.make_async_copy(tpl_out.at[pl.ds(g, 1)], pl_line, sem.at[1])
         cp2.start()
-        cp3 = pltpu.make_async_copy(cnt_out.at[pl.ds(cg, 1)], cnt_line, sem.at[2])
-        cp3.start()
         cp.wait()
         cp2.wait()
-        cp3.wait()
 
-    def flush(g, cg):
-        cp = pltpu.make_async_copy(fp_line, tfp_out.at[pl.ds(g, 1)], sem.at[3])
+    def flush(g):
+        cp = pltpu.make_async_copy(fp_line, tfp_out.at[pl.ds(g, 1)], sem.at[2])
         cp.start()
-        cp2 = pltpu.make_async_copy(pl_line, tpl_out.at[pl.ds(g, 1)], sem.at[4])
+        cp2 = pltpu.make_async_copy(pl_line, tpl_out.at[pl.ds(g, 1)], sem.at[3])
         cp2.start()
-        cp3 = pltpu.make_async_copy(cnt_line, cnt_out.at[pl.ds(cg, 1)], sem.at[5])
-        cp3.start()
         cp.wait()
         cp2.wait()
-        cp3.wait()
 
-    def body(j, carry):
-        cur_g, cur_cg = carry
+    def body(j, cur_g):
         g = meta_ref[j, 0]
         lane = meta_ref[j, 1]
-        cg = meta_ref[j, 6]
-        clane = meta_ref[j, 7]
 
         @pl.when(g != cur_g)
         def _():
             @pl.when(cur_g >= 0)
             def _():
-                flush(cur_g, cur_cg)
+                flush(cur_g)
 
-            fetch(g, cg)
+            fetch(g)
 
         lo = jnp.full((1, GROUP_LANES), 0, jnp.int32) + meta_ref[j, 2]
         hi = jnp.full((1, GROUP_LANES), 0, jnp.int32) + meta_ref[j, 3]
@@ -117,45 +105,34 @@ def _insert_kernel(
             sel_lo, plo.astype(jnp.uint32),
             jnp.where(sel_hi, phi.astype(jnp.uint32), pl_line[:, :]),
         )
-        cnt_line[:, :] = jnp.where(
-            clanes == clane,
-            jnp.full((1, CNT_GROUP), 0, jnp.uint32)
-            + cval_ref[j, 0].astype(jnp.uint32),
-            cnt_line[:, :],
-        )
-        return g, cg
+        return g
 
-    last_g, last_cg = jax.lax.fori_loop(
-        0, n, body, (jnp.int32(-1), jnp.int32(-1))
-    )
+    last_g = jax.lax.fori_loop(0, n, body, jnp.int32(-1))
 
     @pl.when(last_g >= 0)
     def _():
-        flush(last_g, last_cg)
+        flush(last_g)
 
 
 def pallas_scatter_insert(
     table_fp,  # u64 [nslots]
     table_payload,  # u64 [nslots]
-    counts,  # u32 [nbuckets]
-    tgt,  # i32 [M] target slot per sorted candidate (nslots = invalid/pad)
-    cfp,  # u64 [M] fingerprints, bucket-sorted, novel-compacted
+    tgt,  # i32 [M] target slot per candidate (nslots = invalid/pad)
+    cfp,  # u64 [M] fingerprints, novel-compacted (generation order)
     cpl,  # u64 [M]
     n_new,  # i32 scalar: number of valid candidates (prefix of the arrays)
 ):
-    """Write ``cfp/cpl`` to ``tgt`` slots and refresh bucket counts, as one
-    Pallas kernel invocation.  Equivalent to (and validated against) the
-    windowed-scatter path in :func:`ops.buckets.bucket_insert`."""
+    """Write ``cfp/cpl`` to ``tgt`` slots as one Pallas kernel invocation.
+    Equivalent to (and validated against) the fp/payload windowed-scatter
+    path in :func:`ops.buckets.bucket_insert`."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     nslots = table_fp.shape[0]
-    nbuckets = counts.shape[0]
-    # pad tiny tables up to one whole line group / count group (larger-than-
-    # one-group tables are already powers of two, hence multiples); padding
-    # copies, but only on toy sizes — engine-scale tables alias in place
+    # pad tiny tables up to one whole line group (larger-than-one-group
+    # tables are already powers of two, hence multiples); padding copies,
+    # but only on toy sizes — engine-scale tables alias in place
     spad = (-nslots) % GROUP_SLOTS
-    cpad = (-nbuckets) % CNT_GROUP
     if spad:
         table_fp = jnp.concatenate(
             [table_fp, jnp.zeros((spad,), jnp.uint64)]
@@ -163,22 +140,17 @@ def pallas_scatter_insert(
         table_payload = jnp.concatenate(
             [table_payload, jnp.zeros((spad,), jnp.uint64)]
         )
-    if cpad:
-        counts = jnp.concatenate([counts, jnp.zeros((cpad,), jnp.uint32)])
     ngroups = table_fp.shape[0] // GROUP_SLOTS
-    cgroups = counts.shape[0] // CNT_GROUP
     m = tgt.shape[0]
 
     # -- vector-side prep (cheap XLA) --------------------------------------
     valid = tgt < nslots
     slot = jnp.minimum(tgt, nslots - 1)
-    bucket = slot // SLOTS
     g = slot // GROUP_SLOTS
     lane = slot - g * GROUP_SLOTS
-    cg = bucket // CNT_GROUP
-    clane = bucket - cg * CNT_GROUP
     f32 = jax.lax.bitcast_convert_type(cfp, jnp.uint32).astype(jnp.int32)
     p32 = jax.lax.bitcast_convert_type(cpl, jnp.uint32).astype(jnp.int32)
+    zero = jnp.zeros((m,), jnp.int32)
     meta = jnp.stack(
         [
             jnp.where(valid, g, -1),
@@ -187,12 +159,11 @@ def pallas_scatter_insert(
             f32[:, 1],
             p32[:, 0],
             p32[:, 1],
-            cg,
-            clane,
+            zero,
+            zero,
         ],
         axis=1,
     ).astype(jnp.int32)
-    cval = ((slot - bucket * SLOTS) + 1).astype(jnp.int32)[:, None]
 
     tfp32 = jax.lax.bitcast_convert_type(table_fp, jnp.uint32).reshape(
         ngroups, GROUP_LANES
@@ -200,44 +171,36 @@ def pallas_scatter_insert(
     tpl32 = jax.lax.bitcast_convert_type(table_payload, jnp.uint32).reshape(
         ngroups, GROUP_LANES
     )
-    cnt2 = counts.reshape(cgroups, CNT_GROUP)
 
     interpret = jax.default_backend() != "tpu"
-    out_fp, out_pl, out_cnt = pl.pallas_call(
+    out_fp, out_pl = pl.pallas_call(
         _insert_kernel,
         out_shape=[
             jax.ShapeDtypeStruct(tfp32.shape, jnp.uint32),
             jax.ShapeDtypeStruct(tpl32.shape, jnp.uint32),
-            jax.ShapeDtypeStruct(cnt2.shape, jnp.uint32),
         ],
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
             pltpu.VMEM((1, GROUP_LANES), jnp.uint32),
             pltpu.VMEM((1, GROUP_LANES), jnp.uint32),
-            pltpu.VMEM((1, CNT_GROUP), jnp.uint32),
-            pltpu.SemaphoreType.DMA((6,)),
+            pltpu.SemaphoreType.DMA((4,)),
         ],
-        input_output_aliases={3: 0, 4: 1, 5: 2},
+        input_output_aliases={2: 0, 3: 1},
         interpret=interpret,
     )(
         n_new.reshape(1).astype(jnp.int32),
         meta,
-        cval,
         tfp32,
         tpl32,
-        cnt2,
     )
     padded = nslots + spad
     table_fp = jax.lax.bitcast_convert_type(
@@ -246,4 +209,4 @@ def pallas_scatter_insert(
     table_payload = jax.lax.bitcast_convert_type(
         out_pl.reshape(padded, 2), jnp.uint64
     ).reshape(padded)[:nslots]
-    return table_fp, table_payload, out_cnt.reshape(-1)[:nbuckets]
+    return table_fp, table_payload

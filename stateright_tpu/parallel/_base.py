@@ -41,11 +41,24 @@ class WavefrontChecker(Checker):
                 "tensor_model() (see parallel/tensor_model.py) or use "
                 "spawn_bfs()/spawn_dfs()"
             )
+        self._symmetry = options.symmetry_fn
         if options.symmetry_fn is not None:
-            raise NotImplementedError(
-                "symmetry reduction on the TPU engine is not supported yet; "
-                "use spawn_dfs()"
-            )
+            if not hasattr(tensor, "representative_rows"):
+                raise NotImplementedError(
+                    f"{type(tensor).__name__} has no representative_rows(): "
+                    "device symmetry reduction needs a vectorized "
+                    "canonicalizer (see TwoPhaseTensor.representative_rows); "
+                    "use spawn_dfs()"
+                )
+            if not getattr(options, "symmetry_is_default", False):
+                # representative_rows mirrors state.representative(); a
+                # custom symmetry_with fn would silently disagree with the
+                # device dedup and break trace reconstruction
+                raise NotImplementedError(
+                    "the device engines support .symmetry() (the "
+                    "representative() protocol) only; custom symmetry_with "
+                    "functions require spawn_dfs()"
+                )
         if options.visitor_obj is not None:
             raise NotImplementedError(
                 "per-state visitors require host materialization; use "
@@ -141,11 +154,16 @@ class WavefrontChecker(Checker):
     def discoveries(self) -> dict[str, Path]:
         self.join()
         disc = self._results["disc"]
+        key = None
+        if self._symmetry is not None:
+            # device traces record canonical fingerprints; match classes
+            sym, model = self._symmetry, self.model
+            key = lambda s: model.fingerprint_state(sym(s))  # noqa: E731
         out = {}
         for i, prop in enumerate(self._props):
             fp = int(disc[i])
             if fp != 0:
                 out[prop.name] = Path.from_fingerprints(
-                    self.model, self._trace(fp)
+                    self.model, self._trace(fp), key=key
                 )
         return out

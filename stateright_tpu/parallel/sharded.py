@@ -86,6 +86,7 @@ def _build_sharded_run(
     fcap_local: int,
     bucket_cap: int,
     target: Optional[int],
+    sym: bool = False,
 ):
     """Build the jitted whole-run shard_map for fixed per-device capacities."""
     ndev = mesh.shape[AXIS]
@@ -184,7 +185,14 @@ def _build_sharded_run(
         sebt = cand_ebits[order]
         tfp, tpl, novel, toverflow = hash_insert(tfp, tpl, sfp, spar, first)
         n_new = jnp.sum(novel).astype(jnp.int32)
-        keys = jnp.where(novel, jnp.arange(m, dtype=jnp.int32), jnp.int32(m))
+        # symmetry runs compact in generation order (original candidate
+        # position) — see ops/buckets.py on why; plain runs keep sorted order
+        if sym:
+            keys = jnp.where(novel, order.astype(jnp.int32), jnp.int32(m))
+        else:
+            keys = jnp.where(
+                novel, jnp.arange(m, dtype=jnp.int32), jnp.int32(m)
+            )
         take = min(m, fcap_local)  # fewer candidates than frontier slots is fine
         perm = jnp.argsort(keys)[:take]
         nrows = srows[perm]
@@ -208,7 +216,7 @@ def _build_sharded_run(
         # Each device claims the init states it owns (no routing needed: the
         # init set is a replicated constant).
         irows = jnp.asarray(init_rows_np)
-        ifp = row_hash(irows)
+        ifp = row_hash(tensor.representative_rows(irows) if sym else irows)
         mine = owner_of(ifp) == idx
         cand_fp = jnp.where(mine, ifp, EMPTY)
         cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = init state
@@ -246,7 +254,10 @@ def _build_sharded_run(
             terminal = elive & ~jnp.any(valid, axis=-1)
             disc = flush_terminal(terminal, fps, ebits, disc)
 
-            cand_fp = jnp.where(valid, row_hash(succ), EMPTY).reshape(m_cand)
+            # symmetry: route + dedup on the canonical class key while the
+            # frontier carries original rows (see wavefront.py step)
+            krows = tensor.representative_rows(succ) if sym else succ
+            cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m_cand)
             cand_rows = succ.reshape(m_cand, width)
             cand_par = jnp.broadcast_to(fps[:, None], (fcap_local, arity)).reshape(-1)
             cand_ebt = jnp.broadcast_to(ebits[:, None], (fcap_local, arity)).reshape(-1)
@@ -353,12 +364,13 @@ class ShardedTpuChecker(WavefrontChecker):
         mesh_key = tuple(d.id for d in self.mesh.devices.flat)
         while True:
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
-            key = (mesh_key, cap, fcap, bucket_cap, self._target)
+            sym = self._symmetry is not None
+            key = (mesh_key, cap, fcap, bucket_cap, self._target, sym)
             run = cache.get(key)
             if run is None:
                 run = _build_sharded_run(
                     self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
-                    self._target,
+                    self._target, sym=sym,
                 )
                 cache[key] = run
             tfp, tpl, unique, scount, disc, depth, status = run()

@@ -41,6 +41,16 @@ Trace reconstruction is host-side and identical in spirit to the reference
 (``bfs.rs:314-342``): walk parent fingerprints back to an init state, then
 re-execute the *object-form* model (``Path.from_fingerprints``), which works
 because host and device fingerprint functions agree bit-for-bit.
+
+**Symmetry reduction** (beyond the reference, whose symmetry is DFS-only):
+when the builder requests ``symmetry()`` and the tensor twin provides a
+vectorized ``representative_rows``, the engine keeps exploring ORIGINAL
+rows but dedups/keys the table on the canonical class member's hash — the
+device analogue of ``checker/dfs.py::_dedup_key``.  Novel rows are appended
+in generation order, so the reduced search equals a host FIFO-BFS oracle
+exactly (see ``tests/test_tensor_models.py::host_fifo_sym_oracle``); traces
+reconstruct by matching canonical fingerprints class-wise
+(``Path.from_fingerprints(key=...)``).
 """
 
 from __future__ import annotations
@@ -94,7 +104,8 @@ def _stats_np(carry) -> np.ndarray:
 
 
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
-                  steps: int, target: Optional[int], pallas: bool = False):
+                  steps: int, target: Optional[int], pallas: bool = False,
+                  sym: bool = False):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -169,7 +180,12 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         terminal = elive & ~jnp.any(valid, axis=-1)
         disc = flush_terminal(terminal, fps, ebits, disc)
 
-        cand_fp = jnp.where(valid, row_hash(succ), EMPTY).reshape(m)
+        # Under symmetry the search still explores ORIGINAL states (queue
+        # rows) but dedups / keys the table on the canonical class member's
+        # hash — the host analogue is ``checker/dfs.py::_dedup_key``, and it
+        # preserves the reference's pinned symmetry counts (2pc.rs:138).
+        krows = tensor.representative_rows(succ) if sym else succ
+        cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m)
         cand_rows = succ.reshape(m, width)
         cand_par = jnp.broadcast_to(fps[:, None], (batch, arity)).reshape(-1)
         cand_ebt = jnp.broadcast_to(ebits[:, None], (batch, arity)).reshape(-1)
@@ -178,7 +194,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         ).reshape(-1)
 
         tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
-            tfp, tpl, cnt, cand_fp, cand_par, window=batch, use_pallas=pallas
+            tfp, tpl, cnt, cand_fp, cand_par, window=batch,
+            use_pallas=pallas, generation_order=sym,
         )
         # Append novel rows (compacted to the perm front) at the queue tail.
         # Rows past ``n_new`` in the written window are garbage; they sit in
@@ -248,11 +265,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         qdepth = jnp.zeros((qalloc,), jnp.uint32)
 
         irows = jnp.asarray(init_rows_np)
-        ifp = row_hash(irows)
+        ifp = row_hash(tensor.representative_rows(irows) if sym else irows)
         tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
             tfp, tpl, cnt, ifp,
             jnp.zeros((n_init,), jnp.uint64),  # parent 0 = "is an init state"
-            window=n_init, use_pallas=pallas,
+            window=n_init, use_pallas=pallas, generation_order=sym,
         )
         sel = order[perm]
         qrows = jax.lax.dynamic_update_slice(
@@ -357,12 +374,13 @@ class TpuChecker(WavefrontChecker):
         if cache is None:
             cache = {}
             self.tensor._run_cache = cache
-        key = (cap, qcap, batch, self._steps, self._target, self._pallas)
+        sym = self._symmetry is not None
+        key = (cap, qcap, batch, self._steps, self._target, self._pallas, sym)
         eng = cache.get(key)
         if eng is None:
             eng = _build_engine(
                 self.tensor, self._props, cap, qcap, batch, self._steps,
-                self._target, pallas=self._pallas,
+                self._target, pallas=self._pallas, sym=sym,
             )
             cache[key] = eng
         return eng
