@@ -13,7 +13,7 @@ fingerprint ownership:
  - Per wavefront, each device expands its local frontier slice, then routes
    every candidate successor to its owner via ``lax.all_to_all`` over the
    mesh axis — the ICI is the "job market".
- - The owner dedupes + claims table slots locally (``ops/hashtable.py``) and
+ - The owner dedupes + claims table slots locally (``ops/buckets.py``) and
    keeps its novel states as its slice of the next frontier, so the frontier
    stays balanced by fingerprint uniformity rather than explicit stealing.
  - Counters and termination are ``psum``/``pmax`` all-reduces (reference
@@ -53,8 +53,8 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..checker.base import CheckerBuilder
 from ..core import Expectation
+from ..ops.buckets import SLOTS, bucket_insert
 from ..ops.hashing import EMPTY, row_hash
-from ..ops.hashtable import dedupe_sorted, hash_insert
 from ._base import WavefrontChecker
 
 def _to_varying(x):
@@ -174,36 +174,29 @@ def _build_sharded_run(
 
     # -- owner-side dedup + insert + compaction ------------------------------
 
-    def insert_and_compact(tfp, tpl, cand_rows, cand_fp, cand_par, cand_ebits):
-        """Dedup candidates, claim table slots, compact novel rows into a
+    def insert_and_compact(tfp, tpl, cnt, cand_rows, cand_fp, cand_par,
+                           cand_ebits):
+        """Dedup candidates, claim table slots (bucketized one-shot insert —
+        same visited-set as the single-device engine, ``ops/buckets.py``;
+        the round-1 probe-loop ``hash_insert`` cost a full-size scatter per
+        probe iteration on real TPU), compact novel rows into a
         frontier-shaped (exactly ``fcap_local``-row) buffer."""
         m = cand_fp.shape[0]
-        order, first = dedupe_sorted(cand_fp)
-        sfp = cand_fp[order]
-        srows = cand_rows[order]
-        spar = cand_par[order]
-        sebt = cand_ebits[order]
-        tfp, tpl, novel, toverflow = hash_insert(tfp, tpl, sfp, spar, first)
-        n_new = jnp.sum(novel).astype(jnp.int32)
-        # symmetry runs compact in generation order (original candidate
-        # position) — see ops/buckets.py on why; plain runs keep sorted order
-        if sym:
-            keys = jnp.where(novel, order.astype(jnp.int32), jnp.int32(m))
-        else:
-            keys = jnp.where(
-                novel, jnp.arange(m, dtype=jnp.int32), jnp.int32(m)
-            )
+        tfp, tpl, cnt, order, perm, novel, n_new, toverflow = bucket_insert(
+            tfp, tpl, cnt, cand_fp, cand_par,
+            window=min(m, max(64, fcap_local)), generation_order=sym,
+        )
         take = min(m, fcap_local)  # fewer candidates than frontier slots is fine
-        perm = jnp.argsort(keys)[:take]
-        nrows = srows[perm]
-        nfps = jnp.where(jnp.arange(take) < n_new, sfp[perm], EMPTY)
-        nebt = sebt[perm]
+        sel = order[perm][:take]  # original indices, novel-compacted
+        nrows = cand_rows[sel]
+        nfps = jnp.where(jnp.arange(take) < n_new, cand_fp[sel], EMPTY)
+        nebt = cand_ebits[sel]
         pad = fcap_local - take
         if pad > 0:  # always emit exactly fcap_local rows (while_loop carry)
             nrows = jnp.concatenate([nrows, jnp.zeros((pad, width), jnp.uint64)])
             nfps = jnp.concatenate([nfps, jnp.full((pad,), EMPTY, jnp.uint64)])
             nebt = jnp.concatenate([nebt, jnp.zeros((pad,), jnp.uint32)])
-        return tfp, tpl, nrows, nfps, nebt, n_new, toverflow
+        return tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow
 
     # -- the per-device program ----------------------------------------------
 
@@ -212,6 +205,7 @@ def _build_sharded_run(
 
         tfp = _to_varying(jnp.full((cap_local,), EMPTY, jnp.uint64))
         tpl = _to_varying(jnp.zeros((cap_local,), jnp.uint64))
+        cnt = _to_varying(jnp.zeros((cap_local // SLOTS,), jnp.uint32))
 
         # Each device claims the init states it owns (no routing needed: the
         # init set is a replicated constant).
@@ -221,8 +215,8 @@ def _build_sharded_run(
         cand_fp = jnp.where(mine, ifp, EMPTY)
         cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = init state
         cand_ebt = jnp.full((n_init,), init_ebits, jnp.uint32)
-        tfp, tpl, rows0, fps0, ebt0, n_new, toverflow = insert_and_compact(
-            tfp, tpl, irows, cand_fp, cand_par, cand_ebt
+        tfp, tpl, cnt, rows0, fps0, ebt0, n_new, toverflow = insert_and_compact(
+            tfp, tpl, cnt, irows, cand_fp, cand_par, cand_ebt
         )
         unique = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
         foverflow = n_new > fcap_local
@@ -240,7 +234,8 @@ def _build_sharded_run(
             go = go & (unique < jnp.int64(target))
 
         def body(carry):
-            (tfp, tpl, rows, fps, ebits, unique, scount, disc, depth, status, go) = carry
+            (tfp, tpl, cnt, rows, fps, ebits, unique, scount, disc, depth,
+             status, go) = carry
             live = fps != EMPTY
             ebits, disc = eval_props(rows, fps, live, ebits, disc)
             # Mid-block early exit (reference ``bfs.rs:121-128``): mask the
@@ -265,8 +260,8 @@ def _build_sharded_run(
             rfp, rrows, rpar, rebt, boverflow = route(
                 cand_fp, cand_rows, cand_par, cand_ebt
             )
-            tfp, tpl, nrows, nfps, nebt, n_new, toverflow = insert_and_compact(
-                tfp, tpl, rrows, rfp, rpar, rebt
+            tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow = (
+                insert_and_compact(tfp, tpl, cnt, rrows, rfp, rpar, rebt)
             )
             n_new_g = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
             unique = unique + n_new_g
@@ -285,11 +280,13 @@ def _build_sharded_run(
             go = (status == _OK) & (n_new_g > 0) & ~all_discovered(disc)
             if target is not None:
                 go = go & (unique < jnp.int64(target))
-            return (tfp, tpl, nrows, nfps, nebt, unique, scount, disc, depth, status, go)
+            return (tfp, tpl, cnt, nrows, nfps, nebt, unique, scount, disc,
+                    depth, status, go)
 
         carry = (
             tfp,
             tpl,
+            cnt,
             rows0,
             fps0,
             ebt0,
@@ -303,9 +300,9 @@ def _build_sharded_run(
         # Device-local carry components must enter the loop as "varying" over
         # the mesh axis even when their initial value is a replicated constant
         # (shard_map's vma typing for while_loop).
-        carry = tuple(_to_varying(x) for x in carry[:5]) + carry[5:]
+        carry = tuple(_to_varying(x) for x in carry[:6]) + carry[6:]
         carry = jax.lax.while_loop(lambda c: c[-1], body, carry)
-        (tfp, tpl, _, _, _, unique, scount, disc, depth, status, _) = carry
+        (tfp, tpl, _, _, _, _, unique, scount, disc, depth, status, _) = carry
         return tfp, tpl, unique, scount, disc, depth, status
 
     sharded = shard_map(
