@@ -185,7 +185,15 @@ class AbdModel(TensorBackedModel, ActorModel):
     wavefront engine with no protocol-specific device code."""
 
     def tensor_model(self):
+        from ..actor.network import UnorderedNonDuplicatingNetwork
         from ..parallel.actor_compiler import CompileError, compile_actor_model
+
+        if not isinstance(self.init_network, UnorderedNonDuplicatingNetwork):
+            # the state_bound below assumes each message is delivered at most
+            # once; under a duplicating network a redelivered put restarts a
+            # write round, the clock exceeds C in REAL runs (the space is
+            # unbounded), and the bound would poison reachable transitions
+            return None
 
         C = sum(isinstance(a, RegisterClient) for a in self.actors)
 

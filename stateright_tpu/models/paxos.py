@@ -213,7 +213,13 @@ class PaxosModel(TensorBackedModel, ActorModel):
         return self._compiled_tensor(len(clients))
 
     def _compiled_tensor(self, client_count: int):
+        from ..actor.network import UnorderedNonDuplicatingNetwork
         from ..parallel.actor_compiler import CompileError, compile_actor_model
+
+        if not isinstance(self.init_network, UnorderedNonDuplicatingNetwork):
+            # the ballot bound below assumes at-most-once delivery; a
+            # redelivered put starts extra ballots, exceeding C in real runs
+            return None
 
         C = client_count
 

@@ -5,8 +5,9 @@ hand-written 700-line device twin per protocol (``models/paxos_tensor.py``).
 This module makes that a *capability*: given any ``ActorModel`` following the
 standard register-workload shape (reference ``src/actor/register.rs`` — a set
 of protocol servers, ``RegisterClient(put_count=1)`` clients, a
-linearizability-tester history, an unordered non-duplicating network), it
-compiles the Python actor handlers into table-driven jittable ``step_rows``
+linearizability-tester history, an unordered network: non-duplicating
+multiset or duplicating set semantics, optionally lossy), it compiles the
+Python actor handlers into table-driven jittable ``step_rows``
 mechanically.  Reference transition semantics being compiled:
 ``src/actor/model.rs:187-306``.
 
@@ -46,7 +47,11 @@ import numpy as np
 
 from ..actor import Id, SetTimer, CancelTimer, Out, Send
 from ..actor.model import ActorModel, ActorModelState, _default_boundary
-from ..actor.network import Envelope, UnorderedNonDuplicatingNetwork
+from ..actor.network import (
+    Envelope,
+    UnorderedDuplicatingNetwork,
+    UnorderedNonDuplicatingNetwork,
+)
 from ..actor.register import NULL_VALUE, RegisterClient
 from ..semantics import LinearizabilityTester
 from .actor_tensor import (
@@ -183,10 +188,15 @@ class CompiledActorTensor(TensorModel):
 
     def _check_fragment(self) -> None:
         m = self.model
-        if not isinstance(m.init_network, UnorderedNonDuplicatingNetwork):
+        if not isinstance(
+            m.init_network,
+            (UnorderedNonDuplicatingNetwork, UnorderedDuplicatingNetwork),
+        ):
             raise CompileError(
-                "only unordered non-duplicating networks are compilable"
+                "only unordered networks (non-duplicating or duplicating) "
+                "are compilable; ordered networks need per-pair FIFO encoding"
             )
+        self.dup = isinstance(m.init_network, UnorderedDuplicatingNetwork)
         if m._within_boundary is not _default_boundary:
             raise CompileError("custom within_boundary is not compilable")
         if not isinstance(m.init_history, LinearizabilityTester):
@@ -442,9 +452,11 @@ class CompiledActorTensor(TensorModel):
             if self.hist.wfail_bits:
                 vals[f"h{c}_wfail"] = wfail
         vals["poison"] = 0
-        return self.pk.pack(**vals) + self.codec.pack(
-            st.network._counts.items()
-        )
+        if self.dup:
+            pairs = ((env, 1) for env in st.network.iter_all())
+        else:
+            pairs = st.network._counts.items()
+        return self.pk.pack(**vals) + self.codec.pack(pairs)
 
     def decode_state(self, row) -> ActorModelState:
         d = self.pk.unpack(row[: self.pw])
@@ -467,9 +479,13 @@ class CompiledActorTensor(TensorModel):
                 for c in range(self.C)
             ]
         )
-        network = UnorderedNonDuplicatingNetwork(
-            dict(self.codec.unpack(row[self.pw :]))
-        )
+        pairs = self.codec.unpack(row[self.pw :])
+        if self.dup:
+            network = UnorderedDuplicatingNetwork(
+                {env: None for env, _ in pairs}
+            )
+        else:
+            network = UnorderedNonDuplicatingNetwork(dict(pairs))
         return ActorModelState(
             actor_states=actors,
             network=network,
@@ -534,15 +550,21 @@ class CompiledActorTensor(TensorModel):
         # -- successor slot arrays ------------------------------------------
         slots_b = jnp.broadcast_to(slots[:, None, :], (B, NS, NS))
         diag = jnp.eye(NS, dtype=bool)[None]
-        count = (slots & u64(COUNT_MASK)).astype(i32)
-        delivered = jnp.where(
-            count <= 1, u64(SLOT_EMPTY), slots - u64(1)
-        )  # [B, NS]
+        if self.dup:
+            # duplicating network: delivery leaves the envelope in flight
+            # (reference ``network.rs:203-205``); only drops remove it
+            delivered = slots
+        else:
+            count = (slots & u64(COUNT_MASK)).astype(i32)
+            delivered = jnp.where(
+                count <= 1, u64(SLOT_EMPTY), slots - u64(1)
+            )  # [B, NS]
         slots_d = jnp.where(diag, delivered[:, :, None], slots_b)
         for k in range(self.K):
             sk = send_codes[..., k]
             slots_d, of = slot_send(
-                slots_d, sk.astype(u64), valid & (sk >= 0)
+                slots_d, sk.astype(u64), valid & (sk >= 0),
+                set_semantics=self.dup,
             )
             poison = poison | of
         slots_d = slot_canonicalize(slots_d)
@@ -631,7 +653,14 @@ class CompiledActorTensor(TensorModel):
             return succ, valid
 
         # -- drop actions (lossy networks): consume without delivering ------
-        slots_drop = jnp.where(diag, delivered[:, :, None], slots_b)
+        # a duplicating network's drop removes the envelope forever
+        # (reference ``network.rs:242-244``); non-duplicating drops one copy
+        dropped = (
+            jnp.full_like(slots, u64(SLOT_EMPTY))
+            if self.dup
+            else delivered
+        )
+        slots_drop = jnp.where(diag, dropped[:, :, None], slots_b)
         drop_rows = jnp.concatenate(
             [
                 jnp.broadcast_to(rows[:, None, : self.pw], (B, NS, self.pw)),

@@ -100,7 +100,7 @@ def slot_deliver(slots, index: int):
     return slots.at[..., index].set(neww)
 
 
-def slot_send(slots, code, enable):
+def slot_send(slots, code, enable, set_semantics: bool = False):
     """Add one instance of ``code`` (uint64[...]) where ``enable`` (bool[...]).
 
     Existing code -> count+1; else claim the first free slot (one-hot
@@ -110,16 +110,24 @@ def slot_send(slots, code, enable):
     the matched slot's count field is saturated (a count+1 there would carry
     into the envelope-code bits and silently corrupt the row — the device
     analogue of ``SlotCodec.pack``'s count range check).
+
+    ``set_semantics`` models a *duplicating* network's envelope SET
+    (reference ``network.rs:203-205``): sending an already-present code is a
+    no-op instead of a count bump, and cannot overflow the count field.
     """
     n = slots.shape[-1]
     match = slot_occupied(slots) & (slot_codes(slots) == code[..., None])
     exists = jnp.any(match, axis=-1)
-    maxed = jnp.any(
-        match & (slot_counts(slots) == jnp.uint64(COUNT_MASK)), axis=-1
-    )
-    bumped = jnp.where(
-        match & (enable & ~maxed)[..., None], slots + jnp.uint64(1), slots
-    )
+    if set_semantics:
+        maxed = jnp.zeros_like(exists)
+        bumped = slots
+    else:
+        maxed = jnp.any(
+            match & (slot_counts(slots) == jnp.uint64(COUNT_MASK)), axis=-1
+        )
+        bumped = jnp.where(
+            match & (enable & ~maxed)[..., None], slots + jnp.uint64(1), slots
+        )
 
     free = ~slot_occupied(slots)
     first_free = jnp.argmax(free, axis=-1)  # 0 if none free; gated below

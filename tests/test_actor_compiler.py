@@ -160,3 +160,94 @@ def test_compiled_paxos_agrees_with_hand_twin():
     )
     assert h.unique_state_count() == c.unique_state_count() == 265
     assert set(h.discoveries()) == set(c.discoveries())
+
+
+# -- duplicating-network compilation -----------------------------------------
+
+
+def test_single_copy_duplicating_compiled_equivalence():
+    """Duplicating network (redelivery allowed; reference network.rs:203-205)
+    through the mechanical compiler: full device/host parity."""
+    from stateright_tpu.actor import Network
+
+    m = single_copy_model(2, 1, Network.new_unordered_duplicating())
+    tm = m.tensor_model()
+    assert tm is not None and tm.dup
+    crawl_and_check(m, tm)
+
+
+def test_single_copy_duplicating_engine_finds_redelivery_violation():
+    """With redelivery even ONE server violates linearizability (a stale
+    get_ok returns an old value after a newer write completed); both engines
+    must find it.  Counts differ across engines on violating runs (each
+    early-exits at its own point once every property has a discovery)."""
+    from stateright_tpu.actor import Network
+
+    def build():
+        return single_copy_model(2, 1, Network.new_unordered_duplicating())
+
+    cpu = build().checker().spawn_bfs().join()
+    tpu = build().checker().spawn_tpu(sync=True)
+    assert set(cpu.discoveries()) == set(tpu.discoveries()) == {
+        "linearizable",
+        "value chosen",
+    }
+    m = build()
+    path = tpu.discovery("linearizable")
+    assert not m.property_by_name("linearizable").condition(m, path.final_state())
+
+
+def test_single_copy_duplicating_full_enumeration_parity():
+    """1 client / 1 server: no concurrency, so linearizability holds and
+    both engines enumerate the whole (finite) duplicating-network space —
+    counts must agree exactly."""
+    from stateright_tpu.actor import Network
+
+    def build():
+        return single_copy_model(1, 1, Network.new_unordered_duplicating())
+
+    cpu = build().checker().spawn_bfs().join()
+    tpu = build().checker().spawn_tpu(sync=True)
+    assert "linearizable" not in cpu.discoveries()
+    assert cpu.unique_state_count() == tpu.unique_state_count()
+    assert set(cpu.discoveries()) == set(tpu.discoveries())
+
+
+def test_single_copy_lossy_duplicating_parity():
+    """Lossy + duplicating (the reference's harshest unordered config): a
+    drop removes the envelope forever (network.rs:242-244) while deliveries
+    never consume it; full-enumeration count parity on the 1-client system."""
+    from stateright_tpu.actor import Network
+
+    def build():
+        m = single_copy_model(1, 1, Network.new_unordered_duplicating())
+        m.lossy_network(True)
+        return m
+
+    cpu = build().checker().spawn_bfs().join()
+    tpu = build().checker().spawn_tpu(sync=True)
+    assert "linearizable" not in cpu.discoveries()
+    assert cpu.unique_state_count() == tpu.unique_state_count()
+    assert set(cpu.discoveries()) == set(tpu.discoveries())
+
+
+def test_bounded_models_reject_duplicating_twins():
+    """ABD/paxos closure bounds assume at-most-once delivery (a redelivered
+    put restarts a round, growing clocks/ballots unboundedly), so their
+    compiled twins must refuse duplicating networks and fall back to
+    structural fingerprints rather than poison real reachable states."""
+    from stateright_tpu.actor import Network
+
+    m = abd_model(1, 2, Network.new_unordered_duplicating())
+    assert m.tensor_model() is None
+    # structural fingerprints survive genuinely redelivery-reachable states
+    s = m.init_states()[0]
+    for _ in range(8):
+        nxt = m.next_states(s)
+        if not nxt:
+            break
+        s = nxt[0]
+        m.fingerprint_state(s)
+
+    p = paxos_model(1, 3, Network.new_unordered_duplicating())
+    assert p.tensor_model() is None
