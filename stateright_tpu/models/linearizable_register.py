@@ -185,10 +185,16 @@ class AbdModel(TensorBackedModel, ActorModel):
     wavefront engine with no protocol-specific device code."""
 
     def tensor_model(self):
-        from ..actor.network import UnorderedNonDuplicatingNetwork
+        from ..actor.network import (
+            OrderedNetwork,
+            UnorderedNonDuplicatingNetwork,
+        )
         from ..parallel.actor_compiler import CompileError, compile_actor_model
 
-        if not isinstance(self.init_network, UnorderedNonDuplicatingNetwork):
+        if not isinstance(
+            self.init_network,
+            (UnorderedNonDuplicatingNetwork, OrderedNetwork),
+        ):
             # the state_bound below assumes each message is delivered at most
             # once; under a duplicating network a redelivered put restarts a
             # write round, the clock exceeds C in REAL runs (the space is

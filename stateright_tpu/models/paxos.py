@@ -213,10 +213,16 @@ class PaxosModel(TensorBackedModel, ActorModel):
         return self._compiled_tensor(len(clients))
 
     def _compiled_tensor(self, client_count: int):
-        from ..actor.network import UnorderedNonDuplicatingNetwork
+        from ..actor.network import (
+            OrderedNetwork,
+            UnorderedNonDuplicatingNetwork,
+        )
         from ..parallel.actor_compiler import CompileError, compile_actor_model
 
-        if not isinstance(self.init_network, UnorderedNonDuplicatingNetwork):
+        if not isinstance(
+            self.init_network,
+            (UnorderedNonDuplicatingNetwork, OrderedNetwork),
+        ):
             # the ballot bound below assumes at-most-once delivery; a
             # redelivered put starts extra ballots, exceeding C in real runs
             return None

@@ -49,6 +49,7 @@ from ..actor import Id, SetTimer, CancelTimer, Out, Send
 from ..actor.model import ActorModel, ActorModelState, _default_boundary
 from ..actor.network import (
     Envelope,
+    OrderedNetwork,
     UnorderedDuplicatingNetwork,
     UnorderedNonDuplicatingNetwork,
 )
@@ -61,6 +62,7 @@ from .actor_tensor import (
     SlotCodec,
     slot_canonicalize,
     slot_send,
+    slot_send_ordered,
 )
 from .history_tensor import (
     PHASE_DONE,
@@ -190,13 +192,18 @@ class CompiledActorTensor(TensorModel):
         m = self.model
         if not isinstance(
             m.init_network,
-            (UnorderedNonDuplicatingNetwork, UnorderedDuplicatingNetwork),
+            (
+                UnorderedNonDuplicatingNetwork,
+                UnorderedDuplicatingNetwork,
+                OrderedNetwork,
+            ),
         ):
             raise CompileError(
-                "only unordered networks (non-duplicating or duplicating) "
-                "are compilable; ordered networks need per-pair FIFO encoding"
+                "unsupported network semantics: "
+                + type(m.init_network).__name__
             )
         self.dup = isinstance(m.init_network, UnorderedDuplicatingNetwork)
+        self.ordered = isinstance(m.init_network, OrderedNetwork)
         if m._within_boundary is not _default_boundary:
             raise CompileError("custom within_boundary is not compilable")
         if not isinstance(m.init_history, LinearizabilityTester):
@@ -405,6 +412,12 @@ class CompiledActorTensor(TensorModel):
         self._env_dst = np.asarray(
             [int(e.dst) for e in self._envs], np.int32
         )
+        # directed flow id (ordered networks): the envelope code determines
+        # (src, dst), so same-code implies same flow
+        self._env_pair = np.asarray(
+            [int(e.src) * self.n_actors + int(e.dst) for e in self._envs],
+            np.int32,
+        )
         kinds = np.full(ne, _K_OTHER, np.int32)
         vals = np.zeros(ne, np.int32)
         chosen = np.zeros(ne, bool)
@@ -452,7 +465,14 @@ class CompiledActorTensor(TensorModel):
             if self.hist.wfail_bits:
                 vals[f"h{c}_wfail"] = wfail
         vals["poison"] = 0
-        if self.dup:
+        if self.ordered:
+            # slot "count" = 1-based rank within the directed flow (1 = head)
+            pairs = (
+                (Envelope(k[0], k[1], msg), pos + 1)
+                for k, flow in st.network._flows.items()
+                for pos, msg in enumerate(flow)
+            )
+        elif self.dup:
             pairs = ((env, 1) for env in st.network.iter_all())
         else:
             pairs = st.network._counts.items()
@@ -480,7 +500,21 @@ class CompiledActorTensor(TensorModel):
             ]
         )
         pairs = self.codec.unpack(row[self.pw :])
-        if self.dup:
+        if self.ordered:
+            flows: dict = {}
+            for env, rank1 in pairs:
+                flows.setdefault((env.src, env.dst), []).append(
+                    (rank1, env.msg)
+                )
+            network = OrderedNetwork(
+                {
+                    k: tuple(
+                        msg for _, msg in sorted(v, key=lambda t: t[0])
+                    )
+                    for k, v in flows.items()
+                }
+            )
+        elif self.dup:
             network = UnorderedDuplicatingNetwork(
                 {env: None for env, _ in pairs}
             )
@@ -507,6 +541,7 @@ class CompiledActorTensor(TensorModel):
                 "sends": [jnp.asarray(t) for t in self._sends_np],
                 "poison": [jnp.asarray(t) for t in self._poison_np],
                 "env_dst": jnp.asarray(self._env_dst),
+                "env_pair": jnp.asarray(self._env_pair),
                 "env_kind": jnp.asarray(self._env_kind),
                 "env_val": jnp.asarray(self._env_val),
                 "env_chosen": jnp.asarray(self._env_chosen),
@@ -529,6 +564,13 @@ class CompiledActorTensor(TensorModel):
             occupied, (slots >> u64(COUNT_BITS)).astype(i32), 0
         )  # [B, NS]
         dst = cst["env_dst"][ecode]  # [B, NS]
+        if self.ordered:
+            # count bits hold the 1-based rank within the directed flow;
+            # only the head (rank 1) of each flow is deliverable
+            # (reference ``model.rs:224-227``)
+            rank1 = (slots & u64(COUNT_MASK)).astype(i32)  # [B, NS]
+            pair = jnp.where(occupied, cst["env_pair"][ecode], -1)
+            at_head = occupied & (rank1 == 1)
 
         # -- deliver actions (slot a delivers envelope in slot a) -----------
         new_scode = jnp.zeros((B, NS), i32)
@@ -546,26 +588,44 @@ class CompiledActorTensor(TensorModel):
             valid = valid | (mask & (nc >= 0))
             poison = poison | (mask & pi)
             send_codes = jnp.where(mask[..., None], ks, send_codes)
+        if self.ordered:
+            valid = valid & at_head
 
         # -- successor slot arrays ------------------------------------------
         slots_b = jnp.broadcast_to(slots[:, None, :], (B, NS, NS))
         diag = jnp.eye(NS, dtype=bool)[None]
-        if self.dup:
-            # duplicating network: delivery leaves the envelope in flight
-            # (reference ``network.rs:203-205``); only drops remove it
-            delivered = slots
+        if self.ordered:
+            # delivering the head removes it and advances the rest of its
+            # flow by one rank (empty flows vanish with their last slot)
+            pair_a = pair[:, :, None]  # flow of the delivered envelope
+            pair_s = pair[:, None, :]  # flow of each slot
+            same_flow = (pair_a >= 0) & (pair_a == pair_s)
+            slots_d = jnp.where(same_flow, slots_b - u64(1), slots_b)
+            slots_d = jnp.where(diag, u64(SLOT_EMPTY), slots_d)
         else:
-            count = (slots & u64(COUNT_MASK)).astype(i32)
-            delivered = jnp.where(
-                count <= 1, u64(SLOT_EMPTY), slots - u64(1)
-            )  # [B, NS]
-        slots_d = jnp.where(diag, delivered[:, :, None], slots_b)
+            if self.dup:
+                # duplicating network: delivery leaves the envelope in
+                # flight (reference ``network.rs:203-205``); only drops
+                # remove it
+                delivered = slots
+            else:
+                count = (slots & u64(COUNT_MASK)).astype(i32)
+                delivered = jnp.where(
+                    count <= 1, u64(SLOT_EMPTY), slots - u64(1)
+                )  # [B, NS]
+            slots_d = jnp.where(diag, delivered[:, :, None], slots_b)
         for k in range(self.K):
             sk = send_codes[..., k]
-            slots_d, of = slot_send(
-                slots_d, sk.astype(u64), valid & (sk >= 0),
-                set_semantics=self.dup,
-            )
+            if self.ordered:
+                slots_d, of = slot_send_ordered(
+                    slots_d, sk.astype(u64), cst["env_pair"],
+                    valid & (sk >= 0),
+                )
+            else:
+                slots_d, of = slot_send(
+                    slots_d, sk.astype(u64), valid & (sk >= 0),
+                    set_semantics=self.dup,
+                )
             poison = poison | of
         slots_d = slot_canonicalize(slots_d)
 
@@ -653,14 +713,29 @@ class CompiledActorTensor(TensorModel):
             return succ, valid
 
         # -- drop actions (lossy networks): consume without delivering ------
-        # a duplicating network's drop removes the envelope forever
-        # (reference ``network.rs:242-244``); non-duplicating drops one copy
-        dropped = (
-            jnp.full_like(slots, u64(SLOT_EMPTY))
-            if self.dup
-            else delivered
-        )
-        slots_drop = jnp.where(diag, dropped[:, :, None], slots_b)
+        if self.ordered:
+            # the object model enumerates Drop only over the deliverable
+            # envelopes — flow HEADS (``actor/model.py`` iter_deliverable) —
+            # so an ordered drop's network effect is exactly the deliver
+            # effect: remove the head, advance the rest of its flow
+            same_flow = (pair[:, :, None] >= 0) & (
+                pair[:, :, None] == pair[:, None, :]
+            )
+            slots_drop = jnp.where(
+                diag,
+                u64(SLOT_EMPTY),
+                jnp.where(same_flow, slots_b - u64(1), slots_b),
+            )
+        else:
+            # a duplicating network's drop removes the envelope forever
+            # (reference ``network.rs:242-244``); non-duplicating drops one
+            # copy
+            dropped = (
+                jnp.full_like(slots, u64(SLOT_EMPTY))
+                if self.dup
+                else delivered
+            )
+            slots_drop = jnp.where(diag, dropped[:, :, None], slots_b)
         drop_rows = jnp.concatenate(
             [
                 jnp.broadcast_to(rows[:, None, : self.pw], (B, NS, self.pw)),
@@ -669,7 +744,8 @@ class CompiledActorTensor(TensorModel):
             axis=-1,
         )
         succ = jnp.concatenate([succ, drop_rows], axis=1)
-        valid = jnp.concatenate([valid, occupied], axis=1)
+        droppable = at_head if self.ordered else occupied
+        valid = jnp.concatenate([valid, droppable], axis=1)
         return succ, valid
 
     def _client_of_dev(self):

@@ -142,6 +142,32 @@ def slot_send(slots, code, enable, set_semantics: bool = False):
     return claimed, overflow
 
 
+def slot_send_ordered(slots, code, pair_lookup, enable):
+    """Append ``code`` at the TAIL of its directed flow (ordered networks):
+    the claimed slot's count bits get rank ``1 + |in-flight same-flow
+    envelopes|``.  No dedup — ordered flows hold duplicates at distinct
+    ranks.  ``pair_lookup`` maps envelope codes to flow ids.  Returns
+    ``(slots, overflow)``; overflow = no free slot, or the flow is already
+    ``COUNT_MASK`` deep (rank would corrupt the code bits)."""
+    n = slots.shape[-1]
+    occ = slot_occupied(slots)
+    pair_s = jnp.where(occ, pair_lookup[slot_codes(slots).astype(jnp.int32)], -1)
+    pair_c = pair_lookup[code.astype(jnp.int32)]
+    in_flow = occ & (pair_s == pair_c[..., None])
+    depth = jnp.sum(in_flow, axis=-1).astype(jnp.uint64)
+
+    free = ~occ
+    first_free = jnp.argmax(free, axis=-1)
+    any_free = jnp.any(free, axis=-1)
+    too_deep = depth >= jnp.uint64(COUNT_MASK)
+    claim = enable & any_free & ~too_deep
+    onehot = (jnp.arange(n) == first_free[..., None]) & claim[..., None]
+    neww = (code << jnp.uint64(COUNT_BITS)) | (depth + jnp.uint64(1))
+    claimed = jnp.where(onehot, neww[..., None], slots)
+    overflow = enable & (~any_free | too_deep)
+    return claimed, overflow
+
+
 def slot_canonicalize(slots):
     """Sort slots ascending; EMPTY (all-ones) sinks to the end."""
     return jnp.sort(slots, axis=-1)

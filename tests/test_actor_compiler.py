@@ -251,3 +251,82 @@ def test_bounded_models_reject_duplicating_twins():
 
     p = paxos_model(1, 3, Network.new_unordered_duplicating())
     assert p.tensor_model() is None
+
+
+# -- ordered-network compilation ---------------------------------------------
+
+
+def test_single_copy_ordered_compiled_equivalence():
+    """Ordered (per-pair FIFO) network through the compiler: rank-in-slot
+    encoding must reproduce the object flows state-for-state."""
+    from stateright_tpu.actor import Network
+
+    m = single_copy_model(2, 1, Network.new_ordered())
+    tm = m.tensor_model()
+    assert tm is not None and tm.ordered
+    crawl_and_check(m, tm)
+
+
+def test_abd_ordered_compiled_equivalence():
+    from stateright_tpu.actor import Network
+
+    m = abd_model(2, 2, Network.new_ordered())
+    tm = m.tensor_model()
+    assert tm is not None and tm.ordered
+    crawl_and_check(m, tm, max_levels=6)
+
+
+def test_abd_ordered_engine_parity():
+    """The reference bench protocol's ``lin-reg N ordered`` config
+    (bench.sh:31-34) on the device engine."""
+    from stateright_tpu.actor import Network
+
+    def build():
+        return abd_model(2, 2, Network.new_ordered())
+
+    cpu = build().checker().spawn_bfs().join()
+    tpu = build().checker().spawn_tpu(sync=True)
+    assert "linearizable" not in cpu.discoveries()
+    assert cpu.unique_state_count() == tpu.unique_state_count()
+    assert set(cpu.discoveries()) == set(tpu.discoveries())
+
+
+def test_single_copy_ordered_lossy_parity():
+    """Lossy ordered network: drops remove flow heads only (the object model
+    enumerates Drop over iter_deliverable)."""
+    from stateright_tpu.actor import Network
+
+    def build():
+        m = single_copy_model(1, 1, Network.new_ordered())
+        m.lossy_network(True)
+        return m
+
+    cpu = build().checker().spawn_bfs().join()
+    tpu = build().checker().spawn_tpu(sync=True)
+    assert cpu.unique_state_count() == tpu.unique_state_count()
+    assert set(cpu.discoveries()) == set(tpu.discoveries())
+
+
+def test_paxos_ordered_lossy_deep_flow_equivalence():
+    """Lossy ordered paxos reaches ≥2-deep flows (e.g. prepare then accept
+    queued on one pair), exercising head-only drop semantics and mid-flow
+    rank bookkeeping that shallow configs cannot distinguish."""
+    from stateright_tpu.actor import Network
+
+    m = paxos_model(1, 3, Network.new_ordered())
+    m.lossy_network(True)
+    tm = m.tensor_model()
+    assert tm is not None and tm.ordered
+    crawl_and_check(m, tm)
+
+
+def test_paxos_ordered_engine_parity():
+    from stateright_tpu.actor import Network
+
+    def build():
+        return paxos_model(1, 3, Network.new_ordered())
+
+    cpu = build().checker().spawn_bfs().join()
+    tpu = build().checker().spawn_tpu(sync=True)
+    assert cpu.unique_state_count() == tpu.unique_state_count() == 99
+    assert set(cpu.discoveries()) == set(tpu.discoveries())

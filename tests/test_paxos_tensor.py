@@ -113,8 +113,14 @@ def test_paxos_tensor_eligibility():
     # benchmark shape -> hand-tuned twin; other shapes -> mechanical compiler
     assert isinstance(paxos_model(2, 3).tensor_model(), PaxosTensor)
     assert isinstance(paxos_model(2, 4).tensor_model(), CompiledActorTensor)
-    # ordered networks are outside both fragments
-    assert paxos_model(2, 3, Network.new_ordered()).tensor_model() is None
+    # ordered networks go through the compiler's rank-in-slot FIFO encoding
+    tm = paxos_model(2, 3, Network.new_ordered()).tensor_model()
+    assert isinstance(tm, CompiledActorTensor) and tm.ordered
+    # duplicating networks make ballots unbounded -> no twin (structural CPU)
+    assert (
+        paxos_model(2, 3, Network.new_unordered_duplicating()).tensor_model()
+        is None
+    )
 
 
 def test_paxos_compiled_4_servers_matches_cpu():
