@@ -261,6 +261,27 @@ def main(argv=None):
             default_threads()
         ).spawn_bfs().report()
 
+    def check_tpu(rest):
+        client_count = int(rest[0]) if rest else 2
+        network = (
+            Network.from_name(rest[1])
+            if len(rest) > 1
+            else Network.new_unordered_nonduplicating()
+        )
+        print(
+            f"Model checking a linearizable register with {client_count} "
+            "clients on the device wavefront engine."
+        )
+        m = abd_model(client_count, 2, network)
+        if m.tensor_model() is None:
+            print(
+                f"the {network.name} network has no device twin here: "
+                "redelivery makes ABD clocks unbounded (state_bound); use "
+                "`check` (CPU) or a non-duplicating/ordered network"
+            )
+            return
+        m.checker().spawn_tpu().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -283,9 +304,11 @@ def main(argv=None):
 
     run_cli(
         "  linearizable_register check [CLIENT_COUNT] [NETWORK]\n"
+        "  linearizable_register check-tpu [CLIENT_COUNT] [NETWORK]\n"
         "  linearizable_register explore [CLIENT_COUNT] [ADDRESS]\n"
         "  linearizable_register spawn",
         check,
+        check_tpu=check_tpu,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

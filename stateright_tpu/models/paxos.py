@@ -295,7 +295,13 @@ def main(argv=None):
             f"Model checking Single Decree Paxos with {client_count} clients "
             "on the device wavefront engine."
         )
-        b = paxos_model(client_count, 3).checker()
+        m = paxos_model(client_count, 3)
+        if m.tensor_model() is None:
+            print(
+                "this configuration has no device twin; use `check` (CPU)"
+            )
+            return
+        b = m.checker()
         if target:
             b = b.target_states(target)
         b.spawn_tpu().report()

@@ -97,6 +97,23 @@ def main(argv=None):
             default_threads()
         ).spawn_dfs().report()
 
+    def check_tpu(rest):
+        client_count = int(rest[0]) if rest else 2
+        network = (
+            Network.from_name(rest[1])
+            if len(rest) > 1
+            else Network.new_unordered_nonduplicating()
+        )
+        print(
+            f"Model checking a single-copy register with {client_count} "
+            "clients on the device wavefront engine."
+        )
+        m = single_copy_model(client_count, 1, network)
+        if m.tensor_model() is None:
+            print("this configuration has no device twin; use `check` (CPU)")
+            return
+        m.checker().spawn_tpu().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -111,9 +128,11 @@ def main(argv=None):
 
     run_cli(
         "  single_copy_register check [CLIENT_COUNT] [NETWORK]\n"
+        "  single_copy_register check-tpu [CLIENT_COUNT] [NETWORK]\n"
         "  single_copy_register explore [CLIENT_COUNT] [ADDRESS]\n"
         "  single_copy_register spawn",
         check,
+        check_tpu=check_tpu,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

@@ -1,0 +1,115 @@
+"""Liveness (``eventually``) on the device engines: the per-row ebits are
+set at path start, cleared when the condition holds, and flushed as
+counterexamples at terminal rows (reference ``bfs.rs:212-222,265-272``; the
+documented DAG-join/cycle false-negative caveats carry over since ebits are
+not fingerprinted)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from stateright_tpu import Property
+from stateright_tpu.core import Model
+from stateright_tpu.parallel.tensor_model import (
+    BitPacker,
+    TensorBackedModel,
+    TensorModel,
+)
+
+
+class ClimbTensor(TensorModel):
+    """Row = (height, stopped); climb to N step by step, or give up early."""
+
+    def __init__(self, model):
+        self.model = model
+        self.pk = BitPacker([("h", 8), ("stopped", 1)])
+        self.width = self.pk.width
+        self.max_actions = 2
+
+    def encode_state(self, s):
+        return self.pk.pack(h=s[0], stopped=int(s[1]))
+
+    def decode_state(self, row):
+        d = self.pk.unpack(row)
+        return (d["h"], bool(d["stopped"]))
+
+    def init_rows(self):
+        return np.asarray(
+            [self.encode_state(s) for s in self.model.init_states()],
+            np.uint64,
+        )
+
+    def step_rows(self, rows):
+        n = self.model.n
+        h = self.pk.get(rows, "h").astype(jnp.int32)
+        stopped = self.pk.get(rows, "stopped").astype(jnp.int32)
+        live = stopped == 0
+        # action 0: climb
+        climb = self.pk.set(rows[:, None, :], "h", (h + 1)[:, None])
+        climb_ok = (live & (h < n))[:, None]
+        # action 1: give up (terminal sink)
+        stop = self.pk.set(rows[:, None, :], "stopped", jnp.uint64(1))
+        stop_ok = (live & (h < n))[:, None]
+        if not self.model.can_stop:
+            stop_ok = jnp.zeros_like(stop_ok)
+        return (
+            jnp.concatenate([climb, stop], axis=1),
+            jnp.concatenate([climb_ok, stop_ok], axis=1),
+        )
+
+    def property_masks(self, rows):
+        h = self.pk.get(rows, "h").astype(jnp.int32)
+        return (h >= self.model.n)[:, None]
+
+
+class Climb(TensorBackedModel, Model):
+    """``eventually "summited"``: holds on every full climb; a path that
+    gives up terminates below the summit — a liveness counterexample iff
+    giving up is enabled."""
+
+    def __init__(self, n=5, can_stop=True):
+        super().__init__()
+        self.n = n
+        self.can_stop = can_stop
+
+    def tensor_model(self):
+        return ClimbTensor(self)
+
+    def init_states(self):
+        return [(0, False)]
+
+    def actions(self, s):
+        acts = []
+        if not s[1] and s[0] < self.n:
+            acts.append("climb")
+            if self.can_stop:
+                acts.append("stop")
+        return acts
+
+    def next_state(self, s, a):
+        if a == "climb":
+            return (s[0] + 1, s[1])
+        return (s[0], True)
+
+    def properties(self):
+        return [Property.eventually("summited", lambda m, s: s[0] >= m.n)]
+
+
+@pytest.mark.parametrize("devices", [None, 8])
+def test_eventually_counterexample_on_device(devices):
+    kw = dict(devices=devices) if devices else {}
+    checker = Climb(5, can_stop=True).checker().spawn_tpu(sync=True, **kw)
+    cpu = Climb(5, can_stop=True).checker().spawn_bfs().join()
+    assert set(checker.discoveries()) == set(cpu.discoveries()) == {"summited"}
+    path = checker.discovery("summited")
+    final = path.final_state()
+    assert final[1] and final[0] < 5  # gave up below the summit
+
+
+@pytest.mark.parametrize("devices", [None, 8])
+def test_eventually_satisfied_no_discovery(devices):
+    kw = dict(devices=devices) if devices else {}
+    checker = Climb(5, can_stop=False).checker().spawn_tpu(sync=True, **kw)
+    assert checker.discoveries() == {}
+    checker.assert_properties()
