@@ -186,6 +186,7 @@ def tpu_phase() -> dict:
     t_start = time.monotonic()
     budget = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
     out: dict = {}
+    tpu_phase.partial = out  # surfaced on mid-phase failure (see main)
 
     _mark("backend-init (jax.devices)")
     with_tpu_retry(_device_names)
@@ -352,8 +353,11 @@ def main() -> int:
             return 0
         except Exception as e:  # noqa: BLE001
             tb = traceback.format_exc().strip().splitlines()
-            print(json.dumps({"error": f"{type(e).__name__}: {e}",
-                              "tpu_trace_tail": tb[-6:]}))
+            # whatever sections completed before the failure still count
+            partial = getattr(tpu_phase, "partial", {})
+            partial.update({"error": f"{type(e).__name__}: {e}",
+                            "tpu_trace_tail": tb[-6:]})
+            print(json.dumps(partial))
             return 1
 
     extras = cpu_phase()
@@ -379,7 +383,10 @@ def main() -> int:
             parity="paxos check 2 (16668) + 2pc check 5 (8832) on CPU and TPU",
             **extras,
         )
-        return 0
+        # a partial TPU phase can carry the primary metric AND a phase-level
+        # error (e.g. the backend died after the timed run): report the
+        # number but exit nonzero so automation sees the broken run
+        return 1 if "error" in extras else 0
     emit(**extras)
     return 1
 

@@ -172,3 +172,25 @@ def test_paxos3_tpu_vs_cpu_sample():
     assert t.unique_state_count() >= 3000
     # property kernel sanity on visited rows: no linearizability violation
     assert "linearizable" not in t.discoveries()
+
+
+@pytest.mark.slow
+def test_paxos6_device_engine_prefix():
+    """The reference bench config (paxos check 6) runs end-to-end on the
+    device engine: C=6 twin compiles, expands, dedups and evaluates the
+    closure linearizability verdict with no slot-overflow rows and no false
+    violations on a bounded prefix."""
+    from stateright_tpu.parallel import wavefront as wf
+
+    m = paxos_model(6, 3)
+    c = m.checker().target_states(4000).spawn_tpu(
+        sync=True, capacity=1 << 16, frontier_capacity=1 << 9
+    )
+    assert c.unique_state_count() >= 4000
+    assert "linearizable" not in c.discoveries()
+    # every enqueued row is clean: the network never overflowed its slots
+    tm = c.tensor
+    rows = np.asarray(c._final_carry[wf._QROWS])
+    tail = int(np.asarray(c._final_carry[wf._TAIL]))
+    for r in rows[:tail:37]:  # stride-sample the queue
+        assert tm.pk.unpack(r[: tm.pw])["overflow"] == 0
