@@ -87,6 +87,7 @@ def _build_sharded_run(
     bucket_cap: int,
     target: Optional[int],
     sym: bool = False,
+    steps: int = 16,
 ):
     """Build the jitted whole-run shard_map for fixed per-device capacities."""
     ndev = mesh.shape[AXIS]
@@ -200,7 +201,7 @@ def _build_sharded_run(
 
     # -- the per-device program ----------------------------------------------
 
-    def device_program():
+    def device_init():
         idx = jax.lax.axis_index(AXIS)
 
         tfp = _to_varying(jnp.full((cap_local,), EMPTY, jnp.uint64))
@@ -229,13 +230,30 @@ def _build_sharded_run(
                 jnp.int32(_OK),
             ),
         )
-        go = (status == _OK) & (unique > 0)
+        carry = (tfp, tpl, cnt, rows0, fps0, ebt0, unique,
+                 jnp.int64(n_init),  # state_count counts all inits
+                 jnp.zeros((max(n_props, 1),), jnp.uint64),
+                 jnp.int32(0), status)
+        return carry + (keep_going(carry).astype(jnp.int32),)
+
+    def keep_going(carry):
+        fps, unique, disc, status = carry[4], carry[6], carry[8], carry[10]
+        frontier_live = (
+            jax.lax.pmax(jnp.any(fps != EMPTY).astype(jnp.int32), AXIS) > 0
+        )
+        go = (status == _OK) & frontier_live & ~all_discovered(disc)
         if target is not None:
             go = go & (unique < jnp.int64(target))
+        return go
+
+    def device_steps(*carry):
+        """Up to ``steps`` whole-frontier expansions; returns the carry for
+        the next host sync (live counters, target checks, overflow
+        restarts)."""
 
         def body(carry):
             (tfp, tpl, cnt, rows, fps, ebits, unique, scount, disc, depth,
-             status, go) = carry
+             status) = carry
             live = fps != EMPTY
             ebits, disc = eval_props(rows, fps, live, ebits, disc)
             # Mid-block early exit (reference ``bfs.rs:121-128``): mask the
@@ -277,41 +295,32 @@ def _build_sharded_run(
                 ),
             )
             depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
-            go = (status == _OK) & (n_new_g > 0) & ~all_discovered(disc)
-            if target is not None:
-                go = go & (unique < jnp.int64(target))
             return (tfp, tpl, cnt, nrows, nfps, nebt, unique, scount, disc,
-                    depth, status, go)
+                    depth, status)
 
-        carry = (
-            tfp,
-            tpl,
-            cnt,
-            rows0,
-            fps0,
-            ebt0,
-            unique,
-            jnp.int64(n_init),  # state_count counts all inits (bfs parity)
-            jnp.zeros((max(n_props, 1),), jnp.uint64),
-            jnp.int32(0),
-            status,
-            go,
-        )
         # Device-local carry components must enter the loop as "varying" over
         # the mesh axis even when their initial value is a replicated constant
         # (shard_map's vma typing for while_loop).
-        carry = tuple(_to_varying(x) for x in carry[:6]) + carry[6:]
-        carry = jax.lax.while_loop(lambda c: c[-1], body, carry)
-        (tfp, tpl, _, _, _, _, unique, scount, disc, depth, status, _) = carry
-        return tfp, tpl, unique, scount, disc, depth, status
+        carry = tuple(_to_varying(x) for x in carry[:6]) + tuple(carry[6:])
+        _, carry = jax.lax.while_loop(
+            lambda s: (s[0] < steps) & keep_going(s[1]),
+            lambda s: (s[0] + 1, body(s[1])),
+            (jnp.int32(0), carry),
+        )
+        return carry + (keep_going(carry).astype(jnp.int32),)
 
-    sharded = shard_map(
-        device_program,
-        mesh,
-        in_specs=(),
-        out_specs=(P(AXIS), P(AXIS), P(), P(), P(), P(), P()),
+    in_specs = (P(AXIS),) * 6 + (P(),) * 5
+    out_specs = in_specs + (P(),)
+    init_fn = jax.jit(
+        shard_map(device_init, mesh, in_specs=(), out_specs=out_specs)
     )
-    return jax.jit(sharded)
+    step_fn = jax.jit(
+        shard_map(
+            device_steps, mesh, in_specs=in_specs, out_specs=out_specs
+        ),
+        donate_argnums=tuple(range(11)),
+    )
+    return init_fn, step_fn
 
 
 def default_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -337,6 +346,7 @@ class ShardedTpuChecker(WavefrontChecker):
         bucket_factor: int = 2,
         sync: bool = False,
         pallas: Optional[bool] = None,
+        steps_per_call: int = 16,
     ):
         if pallas:
             raise NotImplementedError(
@@ -349,7 +359,29 @@ class ShardedTpuChecker(WavefrontChecker):
         self._cap_local = max(64, _pow2(capacity // self.ndev))
         self._fcap_local = max(16, frontier_capacity // self.ndev)
         self._bucket_factor = bucket_factor
+        self._steps = steps_per_call
+        self._live = (0, 0, 0)  # states, unique, maxdepth
         self._init_common(options, sync)
+
+    # -- live progress (the single jitted call used to hide everything).
+    # Counters reset when an overflow forces a capacity restart: the restart
+    # genuinely discards the previous attempt's work, and the live surface
+    # reports the run that is actually in progress. -------------------------
+
+    def state_count(self) -> int:
+        if self._results:
+            return self._results["states"]
+        return self._live[0]
+
+    def unique_state_count(self) -> int:
+        if self._results:
+            return self._results["unique"]
+        return self._live[1]
+
+    def max_depth(self) -> int:
+        if self._results:
+            return self._results["depth"]
+        return self._live[2]
 
     def _run(self):
         cap, fcap, bf = self._cap_local, self._fcap_local, self._bucket_factor
@@ -359,19 +391,35 @@ class ShardedTpuChecker(WavefrontChecker):
             cache = {}
             self.tensor._sharded_run_cache = cache
         mesh_key = tuple(d.id for d in self.mesh.devices.flat)
-        while True:
+        while True:  # restart with larger capacities on overflow
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
             sym = self._symmetry is not None
-            key = (mesh_key, cap, fcap, bucket_cap, self._target, sym)
-            run = cache.get(key)
-            if run is None:
-                run = _build_sharded_run(
+            key = (mesh_key, cap, fcap, bucket_cap, self._target, sym,
+                   self._steps)
+            fns = cache.get(key)
+            if fns is None:
+                fns = _build_sharded_run(
                     self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
-                    self._target, sym=sym,
+                    self._target, sym=sym, steps=self._steps,
                 )
-                cache[key] = run
-            tfp, tpl, unique, scount, disc, depth, status = run()
-            status = int(status)
+                cache[key] = fns
+            init_fn, step_fn = fns
+            out = init_fn()
+            while True:
+                # only the replicated scalars cross to the host per sync
+                # (one batched transfer); the sharded carry stays
+                # device-resident between calls
+                carry = out[:11]
+                unique, scount, depth, status, more = (
+                    int(x)
+                    for x in jax.device_get(
+                        (out[6], out[7], out[9], out[10], out[11])
+                    )
+                )
+                self._live = (scount, unique, depth)
+                if status != _OK or not more:
+                    break
+                out = step_fn(*carry)
             if status == _TABLE_OVERFLOW:
                 cap *= 2
                 continue
@@ -384,12 +432,12 @@ class ShardedTpuChecker(WavefrontChecker):
             break
         self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf
         self._results = {
-            "unique": int(unique),
-            "states": int(scount),
-            "disc": np.asarray(disc),
-            "depth": int(depth),
-            "table_fp": np.asarray(tfp),
-            "table_parent": np.asarray(tpl),
+            "unique": unique,
+            "states": scount,
+            "disc": np.asarray(carry[8]),
+            "depth": depth,
+            "table_fp": np.asarray(carry[0]),
+            "table_parent": np.asarray(carry[1]),
         }
         self._done.set()
 

@@ -82,3 +82,24 @@ def test_sharded_on_two_devices():
     sys = TwoPhaseSys(3)
     checker = sys.checker().spawn_tpu(devices=2, sync=True)
     assert checker.unique_state_count() == 288
+
+
+def test_sharded_live_progress_counters():
+    """The chunked host loop surfaces live counters mid-run (the old
+    whole-run jit call hid everything until completion)."""
+    import time
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    checker = TwoPhaseSys(5).checker().spawn_tpu(
+        devices=8, capacity=1 << 17, frontier_capacity=1 << 12,
+        steps_per_call=1,
+    )
+    samples = []
+    while not checker.is_done():
+        samples.append(checker.unique_state_count())
+        time.sleep(0.05)
+    checker.join()
+    assert checker.unique_state_count() == 8832
+    # monotone live counters (no overflow restart at these capacities)
+    assert samples == sorted(samples)
