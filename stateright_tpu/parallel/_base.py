@@ -26,6 +26,10 @@ class WavefrontChecker(Checker):
     """Common host-side surface for device wavefront engines."""
 
     def _init_common(self, options: CheckerBuilder, sync: bool):
+        self._stop = threading.Event()
+        self._ckpt_req: Optional[threading.Event] = None
+        self._ckpt_out: Optional[dict] = None
+        self._ckpt_ready = threading.Event()
         self.model = options.model
         # Prefer the cached twin (TensorBackedModel): the compiled-run cache
         # lives on the tensor instance, so a fresh twin per checker would
@@ -85,6 +89,70 @@ class WavefrontChecker(Checker):
 
     def _pre_run_validate(self) -> None:  # engine-specific, optional
         pass
+
+    def _model_sig(self) -> np.ndarray:
+        """Model identity guard for resume: init fingerprints alone can
+        coincide across configurations (e.g. all-zero init rows), so the
+        tensor shape signature is included too."""
+        fps = [
+            self.model.fingerprint_state(s) for s in self.model.init_states()
+        ]
+        return np.asarray(
+            sorted(fps)
+            + [self.tensor.width, self.tensor.max_actions, len(self._props)],
+            np.uint64,
+        )
+
+    _engine_tag = "single"  # overridden by the sharded engine
+
+    def _check_snapshot_sig(self, snap: dict) -> None:
+        tag = str(snap.get("engine", "single"))
+        if tag != self._engine_tag:
+            raise ValueError(
+                f"resume snapshot was taken by the {tag!r} engine; this is "
+                f"the {self._engine_tag!r} engine (pass/drop the devices/"
+                "mesh argument to match)"
+            )
+        if not np.array_equal(self._model_sig(), snap["model_sig"]):
+            raise ValueError(
+                "resume snapshot was taken from a different model "
+                "(init fingerprints / tensor signature disagree)"
+            )
+
+    # -- stop/checkpoint protocol (engines define _final_snapshot and serve
+    # _ckpt_req at their host sync points) -----------------------------------
+
+    def stop(self) -> "WavefrontChecker":
+        """Ask the engine to stop at the next host sync (for checkpointing
+        a run that should be resumed elsewhere)."""
+        self._stop.set()
+        return self
+
+    def checkpoint(self, timeout: Optional[float] = 60.0) -> dict:
+        """Snapshot the run state (numpy arrays, serializable with
+        ``np.savez``).  Mid-run, the snapshot is taken at the next host sync;
+        after completion it reflects the final state.  Continue with
+        ``spawn_tpu(resume=snapshot)`` (same engine/mesh width)."""
+        import time
+
+        if self._done.is_set():
+            return dict(self._final_snapshot)
+        if self._thread is None:  # sync run already finished
+            return dict(self._final_snapshot)
+        self._ckpt_req = self._ckpt_req or threading.Event()
+        self._ckpt_ready.clear()
+        self._ckpt_req.set()
+        # Poll in small increments: the run can finish between our request
+        # and its next checkpoint check, in which case the final snapshot is
+        # the answer and waiting out the full timeout would just stall.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._ckpt_ready.wait(0.2):
+            if self._done.is_set():
+                return dict(self._final_snapshot)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("checkpoint request not served")
+        out, self._ckpt_out = self._ckpt_out, None
+        return out
 
     def _verify_fingerprint_bridge(self):
         """Host fingerprint must equal the device row hash, else traces cannot

@@ -331,10 +331,19 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n]), (AXIS,))
 
 
+_SHARDED_SNAPSHOT_KEYS = (
+    "table_fp", "table_parent", "counts", "rows", "fps", "ebits",
+    "unique", "scount", "disc", "depth", "status",
+)
+
+
 class ShardedTpuChecker(WavefrontChecker):
     """Wavefront BFS sharded over a device mesh (TPU ICI on hardware; in tests
     an 8-device virtual CPU mesh).  Same result surface and restart-on-overflow
-    behavior as the single-device :class:`~.wavefront.TpuChecker`."""
+    behavior as the single-device :class:`~.wavefront.TpuChecker`, including
+    mid-run :meth:`checkpoint` / ``spawn_tpu(devices=N, resume=snapshot)``
+    (the mesh width must match: table shards are partitioned by fingerprint
+    ownership)."""
 
     def __init__(
         self,
@@ -347,12 +356,14 @@ class ShardedTpuChecker(WavefrontChecker):
         sync: bool = False,
         pallas: Optional[bool] = None,
         steps_per_call: int = 16,
+        resume: Optional[dict] = None,
     ):
         if pallas:
             raise NotImplementedError(
                 "the Pallas insert kernel is single-device only for now; "
                 "drop pallas=True or use spawn_tpu() without devices/mesh"
             )
+        self._resume = resume
         self.mesh = mesh if mesh is not None else default_mesh(n_devices)
         self.ndev = self.mesh.shape[AXIS]
         # capacities are global; divide into power-of-two per-device shards
@@ -383,7 +394,46 @@ class ShardedTpuChecker(WavefrontChecker):
             return self._results["depth"]
         return self._live[2]
 
+    def _pre_run_validate(self) -> None:
+        if self._resume is not None:
+            self._check_snapshot_sig(self._resume)
+            if int(self._resume["ndev"]) != self.ndev:
+                raise ValueError(
+                    f"snapshot was taken on a {self._resume['ndev']}-device "
+                    f"mesh; this mesh has {self.ndev} (table shards are "
+                    "partitioned by fingerprint ownership)"
+                )
+
+    _engine_tag = "sharded"
+
+    def _carry_to_snapshot(self, carry, more, cap, fcap, bf) -> dict:
+        snap = {
+            k: np.asarray(v)
+            for k, v in zip(_SHARDED_SNAPSHOT_KEYS, carry)
+        }
+        snap["more"] = int(np.asarray(more))
+        snap["ndev"] = self.ndev
+        snap["cap_local"] = cap
+        snap["fcap_local"] = fcap
+        snap["bucket_factor"] = bf
+        snap["engine"] = self._engine_tag
+        snap["model_sig"] = self._model_sig()
+        return snap
+
+    @property
+    def _final_snapshot(self) -> dict:
+        # lazy: pulling the whole carry through the tunnel costs far more
+        # than the run's last wavefronts, so only checkpoint() pays for it
+        carry, more, caps = self._final_state
+        return self._carry_to_snapshot(carry, more, *caps)
+
     def _run(self):
+        if self._resume is not None:
+            # capacities are baked into the compiled programs; adopt the
+            # snapshot's so the carry shapes line up
+            self._cap_local = int(self._resume["cap_local"])
+            self._fcap_local = int(self._resume["fcap_local"])
+            self._bucket_factor = int(self._resume["bucket_factor"])
         cap, fcap, bf = self._cap_local, self._fcap_local, self._bucket_factor
         arity = self.tensor.max_actions
         cache = getattr(self.tensor, "_sharded_run_cache", None)
@@ -391,6 +441,7 @@ class ShardedTpuChecker(WavefrontChecker):
             cache = {}
             self.tensor._sharded_run_cache = cache
         mesh_key = tuple(d.id for d in self.mesh.devices.flat)
+        resume = self._resume
         while True:  # restart with larger capacities on overflow
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
             sym = self._symmetry is not None
@@ -404,7 +455,15 @@ class ShardedTpuChecker(WavefrontChecker):
                 )
                 cache[key] = fns
             init_fn, step_fn = fns
-            out = init_fn()
+            if resume is not None:
+                carry0 = tuple(resume[k] for k in _SHARDED_SNAPSHOT_KEYS)
+                out = step_fn(*carry0) if resume["more"] else (
+                    tuple(jnp.asarray(c) for c in carry0)
+                    + (jnp.int32(0),)
+                )
+                resume = None  # a restart after overflow re-inits fresh
+            else:
+                out = init_fn()
             while True:
                 # only the replicated scalars cross to the host per sync
                 # (one batched transfer); the sharded carry stays
@@ -417,7 +476,13 @@ class ShardedTpuChecker(WavefrontChecker):
                     )
                 )
                 self._live = (scount, unique, depth)
-                if status != _OK or not more:
+                if self._ckpt_req is not None and self._ckpt_req.is_set():
+                    self._ckpt_out = self._carry_to_snapshot(
+                        carry, more, cap, fcap, bf
+                    )
+                    self._ckpt_req.clear()
+                    self._ckpt_ready.set()
+                if status != _OK or not more or self._stop.is_set():
                     break
                 out = step_fn(*carry)
             if status == _TABLE_OVERFLOW:
@@ -439,6 +504,9 @@ class ShardedTpuChecker(WavefrontChecker):
             "table_fp": np.asarray(carry[0]),
             "table_parent": np.asarray(carry[1]),
         }
+        # keep the final carry device-resident; a stopped run's snapshot
+        # keeps more=1 so resume continues it (see _final_snapshot)
+        self._final_state = (carry, more, (cap, fcap, bf))
         self._done.set()
 
 

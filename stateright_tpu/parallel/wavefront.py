@@ -361,10 +361,6 @@ class TpuChecker(WavefrontChecker):
         self._resume = resume
         self._live = (0, 0, 0)  # states, unique, maxdepth
         self._live_lock = threading.Lock()
-        self._ckpt_req: Optional[threading.Event] = None
-        self._ckpt_out: Optional[dict] = None
-        self._ckpt_ready = threading.Event()
-        self._stop = threading.Event()
         self._init_common(options, sync)
 
     # -- run loop ------------------------------------------------------------
@@ -391,30 +387,13 @@ class TpuChecker(WavefrontChecker):
         }
         snap["cap"], snap["qcap"], snap["batch"] = cap, qcap, self._batch
         snap["width"] = self.tensor.width
+        snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
         return snap
-
-    def _model_sig(self) -> np.ndarray:
-        """Model identity guard for resume: init fingerprints alone can
-        coincide across configurations (e.g. all-zero init rows), so the
-        tensor shape signature is included too."""
-        fps = [self.model.fingerprint_state(s) for s in self.model.init_states()]
-        return np.asarray(
-            sorted(fps)
-            + [self.tensor.width, self.tensor.max_actions, len(self._props)],
-            np.uint64,
-        )
 
     def _pre_run_validate(self) -> None:
         if self._resume is not None:
             self._check_snapshot_sig(self._resume)
-
-    def _check_snapshot_sig(self, snap: dict) -> None:
-        if not np.array_equal(self._model_sig(), snap["model_sig"]):
-            raise ValueError(
-                "resume snapshot was taken from a different model "
-                "(init fingerprints / tensor signature disagree)"
-            )
 
     def _snapshot_to_carry(self, snap: dict):
         self._check_snapshot_sig(snap)
@@ -582,35 +561,8 @@ class TpuChecker(WavefrontChecker):
             return self._results["unique"]
         return self._live[1]
 
-    def stop(self) -> "TpuChecker":
-        """Ask the engine to stop at the next host sync (for checkpointing
-        a run that should be resumed elsewhere)."""
-        self._stop.set()
-        return self
-
-    def checkpoint(self, timeout: Optional[float] = 60.0) -> dict:
-        """Snapshot the run state (numpy arrays, serializable with
-        ``np.savez``).  Mid-run, the snapshot is taken at the next host sync
-        (at most ``steps_per_call`` device steps away); after completion it
-        reflects the final state.  Continue with ``spawn_tpu(resume=snap)``."""
-        if self._done.is_set():
-            return dict(self._final_snapshot)
-        if self._thread is None:  # sync run already finished
-            return dict(self._final_snapshot)
-        self._ckpt_req = self._ckpt_req or threading.Event()
-        self._ckpt_ready.clear()
-        self._ckpt_req.set()
-        # Poll in small increments: the run can finish between our request
-        # and its next checkpoint check, in which case the final snapshot is
-        # the answer and waiting out the full timeout would just stall.
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while not self._ckpt_ready.wait(0.2):
-            if self._done.is_set():
-                return dict(self._final_snapshot)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("checkpoint request not served")
-        out, self._ckpt_out = self._ckpt_out, None
-        return out
+    # stop()/checkpoint() come from WavefrontChecker; this engine serves
+    # _ckpt_req in its host sync loop and defines _final_snapshot above.
 
 
 def _pow2(n: int) -> int:

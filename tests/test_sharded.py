@@ -103,3 +103,58 @@ def test_sharded_live_progress_counters():
     assert checker.unique_state_count() == 8832
     # monotone live counters (no overflow restart at these capacities)
     assert samples == sorted(samples)
+
+
+def test_sharded_checkpoint_resume_matches_uninterrupted():
+    """Stop a sharded run mid-flight, snapshot, resume on a fresh checker:
+    final counts and discoveries must match the uninterrupted run."""
+    import numpy as np
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    kw = dict(devices=8, capacity=1 << 15, frontier_capacity=1 << 10,
+              steps_per_call=1)
+    full = TwoPhaseSys(5).checker().spawn_tpu(sync=True, **kw)
+
+    # start async, snapshot early, stop, resume from the snapshot
+    running = TwoPhaseSys(5).checker().spawn_tpu(**kw)
+    snap = running.checkpoint()
+    running.stop().join()
+    resumed = TwoPhaseSys(5).checker().spawn_tpu(sync=True, resume=snap, **kw)
+    assert resumed.unique_state_count() == full.unique_state_count() == 8832
+    assert set(resumed.discoveries()) == set(full.discoveries())
+    # snapshots survive a real savez/load round trip AND resume from the
+    # loaded NpzFile (0-d scalars, ndev coercion, key set)
+    import io
+
+    buf = io.BytesIO()
+    np.savez(buf, **snap)
+    buf.seek(0)
+    loaded = dict(np.load(buf, allow_pickle=False))
+    resumed2 = TwoPhaseSys(5).checker().spawn_tpu(
+        sync=True, resume=loaded, **kw
+    )
+    assert resumed2.unique_state_count() == 8832
+
+
+def test_sharded_resume_rejects_other_model_or_mesh():
+    import pytest
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    kw = dict(devices=8, capacity=1 << 13, frontier_capacity=1 << 9)
+    c = TwoPhaseSys(3).checker().spawn_tpu(sync=True, **kw)
+    snap = c.checkpoint()
+    with pytest.raises(ValueError, match="different model"):
+        TwoPhaseSys(4).checker().spawn_tpu(sync=True, resume=snap, **kw)
+    with pytest.raises(ValueError, match="mesh"):
+        TwoPhaseSys(3).checker().spawn_tpu(
+            sync=True, devices=4, capacity=1 << 13, frontier_capacity=1 << 9,
+            resume=snap,
+        )
+    # cross-engine confusion is caught, both directions
+    with pytest.raises(ValueError, match="engine"):
+        TwoPhaseSys(3).checker().spawn_tpu(sync=True, resume=snap)
+    single_snap = TwoPhaseSys(3).checker().spawn_tpu(sync=True).checkpoint()
+    with pytest.raises(ValueError, match="engine"):
+        TwoPhaseSys(3).checker().spawn_tpu(sync=True, resume=single_snap, **kw)
