@@ -10,11 +10,14 @@ off a job for idle workers).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Optional
 
 from .base import Checker, CheckerBuilder
+
+log = logging.getLogger(__name__)
 
 
 class _JobMarket:
@@ -67,9 +70,14 @@ class WorkerPoolChecker(Checker):
     # -- pool protocol -------------------------------------------------------
 
     def _worker(self):
+        # thread-lifecycle instrumentation (reference ``bfs.rs:84,95,101,107``
+        # via the log crate); enable with logging.DEBUG on this module
+        log.debug("%s started", threading.current_thread().name)
         try:
             self._worker_loop()
+            log.debug("%s done", threading.current_thread().name)
         except BaseException as e:  # user model bugs must reach join()
+            log.debug("%s failed: %r", threading.current_thread().name, e)
             self._error = e
             self._stop.set()
             self._market.close()

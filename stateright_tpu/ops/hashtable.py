@@ -1,5 +1,11 @@
 """Device-resident visited-set: open-addressing hash table with parallel insert.
 
+RETAINED LIBRARY OP — both engines moved to the bucketized one-shot insert
+(``ops/buckets.py``) after on-chip measurement showed each probe iteration
+of this design costs a full-size scatter; this module stays as a tested,
+portable open-addressing primitive (probe-loop claim protocols are the
+right shape on backends where scatters are cheap).
+
 The reference's shared visited set is a lock-striped concurrent map
 (``DashMap`` — reference ``src/checker/bfs.rs:26``).  The TPU equivalent is an
 HBM-resident table of fingerprints (+ aligned parent-pointer payload) updated
