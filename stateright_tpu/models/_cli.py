@@ -14,6 +14,7 @@ def run_cli(
     check: Callable[[list], None],
     check_sym: Optional[Callable[[list], None]] = None,
     check_tpu: Optional[Callable[[list], None]] = None,
+    check_sym_tpu: Optional[Callable[[list], None]] = None,
     explore: Optional[Callable[[list], None]] = None,
     spawn: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
@@ -27,6 +28,8 @@ def run_cli(
         check_sym(rest)
     elif cmd == "check-tpu" and check_tpu is not None:
         check_tpu(rest)
+    elif cmd == "check-sym-tpu" and check_sym_tpu is not None:
+        check_sym_tpu(rest)
     elif cmd == "explore" and explore is not None:
         explore(rest)
     elif cmd == "spawn" and spawn is not None:

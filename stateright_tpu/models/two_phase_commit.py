@@ -384,25 +384,30 @@ def main(argv=None):
         print(f"Checking two phase commit with {rm_count} RMs on TPU.")
         TwoPhaseSys(rm_count).checker().spawn_tpu().report()
 
+    def check_sym_tpu(rest):
+        rm_count = int(rest[0]) if rest else 2
+        print(
+            f"Checking two phase commit with {rm_count} RMs on TPU "
+            "using symmetry reduction."
+        )
+        TwoPhaseSys(rm_count).checker().symmetry().spawn_tpu().report()
+
     def explore(rest):
         rm_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
         print(f"Exploring 2PC state space with {rm_count} RMs on {addr}.")
         TwoPhaseSys(rm_count).checker().serve(addr)
 
-    import sys
-
-    argv = sys.argv[1:] if argv is None else argv
-    if argv and argv[0] == "check-tpu":
-        check_tpu(argv[1:])
-        return
     run_cli(
         "  two_phase_commit check [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-sym [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-tpu [RESOURCE_MANAGER_COUNT]\n"
+        "  two_phase_commit check-sym-tpu [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit explore [RESOURCE_MANAGER_COUNT] [ADDRESS]",
         check,
         check_sym=check_sym,
+        check_tpu=check_tpu,
+        check_sym_tpu=check_sym_tpu,
         explore=explore,
         argv=argv,
     )
