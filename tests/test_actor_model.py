@@ -316,3 +316,10 @@ def test_helpers():
     assert majority(3) == 2 and majority(4) == 3 and majority(5) == 3
     assert model_peers(1, 3) == [Id(0), Id(2)]
     assert Id.from_addr("127.0.0.1", 3000).to_addr() == ("127.0.0.1", 3000)
+
+
+def test_ping_pong_dfs_agrees_with_bfs():
+    model = ping_pong_model(PingPongCfg(maintains_history=False, max_nat=5))
+    model.lossy = True
+    dfs = model.checker().spawn_dfs().join()
+    assert dfs.unique_state_count() == 4094
