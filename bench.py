@@ -180,6 +180,8 @@ def _mark(stage: str) -> None:
 
 
 def tpu_phase() -> dict:
+    import threading
+
     from stateright_tpu.models.paxos import paxos_model
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
@@ -187,6 +189,15 @@ def tpu_phase() -> dict:
     budget = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
     out: dict = {}
     tpu_phase.partial = out  # surfaced on mid-phase failure (see main)
+
+    def heartbeat():
+        # keeps the parent's stall watchdog fed during long silent sections
+        # (device runs emit no stderr; only a truly hung child goes quiet)
+        while True:
+            time.sleep(60)
+            _mark(f"alive t+{time.monotonic() - t_start:.0f}s")
+
+    threading.Thread(target=heartbeat, daemon=True).start()
 
     _mark("backend-init (jax.devices)")
     with_tpu_retry(_device_names)
@@ -321,20 +332,62 @@ def run_tpu_subprocess(timeout_s: float) -> dict:
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
 
-        def err_tail(n: int = 8) -> list:
-            errf.flush()
-            errf.seek(0)
-            return errf.read().strip().splitlines()[-n:]
+        def read_err() -> list:
+            # os.pread: the child writes through the same file description,
+            # so seeking the shared offset mid-run would corrupt its output
+            size = os.fstat(errf.fileno()).st_size
+            data = os.pread(errf.fileno(), size, 0).decode(errors="replace")
+            return data.strip().splitlines()
 
-        try:
-            stdout, _ = proc.communicate(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.communicate()
-            return {
-                "error": f"TPU phase timed out after {timeout_s:.0f}s",
-                "tpu_trace_tail": err_tail(),
-            }
+        def err_tail(n: int = 8) -> list:
+            # heartbeat lines would flood out the stage marks this exists
+            # to surface
+            return [l for l in read_err() if "stage: alive" not in l][-n:]
+
+        def last_stage() -> str:
+            stage = ""
+            for line in read_err():
+                if line.startswith("bench-tpu-stage:") and "alive" not in line:
+                    stage = line.split(":", 1)[1].strip()
+            return stage
+
+        # Backend-init watchdog on top of the total budget: the axon backend
+        # has been observed to block 25+ minutes inside PJRT client creation
+        # before failing UNAVAILABLE.  If the child is still in backend-init
+        # after BENCH_TPU_INIT_TIMEOUT, kill it early so the CPU numbers
+        # emit without waiting out the whole budget (a healthy init is <60s;
+        # later stages run long legitimately, so only init gets this limit).
+        init_s = float(os.environ.get("BENCH_TPU_INIT_TIMEOUT", "600"))
+        deadline = time.monotonic() + timeout_s
+        t0 = time.monotonic()
+        init_passed = False
+        while True:
+            try:
+                stdout, _ = proc.communicate(timeout=5)
+                break
+            except subprocess.TimeoutExpired:
+                now = time.monotonic()
+                stuck_init = False
+                if not init_passed:
+                    stage = last_stage()
+                    # "" = hung before the first mark (imports/interpreter):
+                    # the same early-init hang class, treated identically
+                    init_passed = stage not in (
+                        "", "backend-init (jax.devices)"
+                    )
+                    stuck_init = not init_passed and now - t0 > init_s
+                if now > deadline or stuck_init:
+                    why = (
+                        f"stuck in backend init for {init_s:.0f}s"
+                        if stuck_init
+                        else f"timed out after {timeout_s:.0f}s"
+                    )
+                    proc.kill()
+                    proc.communicate()
+                    return {
+                        "error": f"TPU phase {why}",
+                        "tpu_trace_tail": err_tail(),
+                    }
         for line in reversed(stdout.strip().splitlines()):
             try:
                 return json.loads(line)
