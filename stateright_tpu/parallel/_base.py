@@ -30,6 +30,11 @@ class WavefrontChecker(Checker):
         self._ckpt_req: Optional[threading.Event] = None
         self._ckpt_out: Optional[dict] = None
         self._ckpt_ready = threading.Event()
+        # serializes concurrent checkpoint() callers: they share the single
+        # _ckpt_req/_ckpt_ready/_ckpt_out triple, and without the lock one
+        # caller could consume the other's snapshot (the loser silently
+        # returning None)
+        self._ckpt_lock = threading.Lock()
         self.model = options.model
         # Prefer the cached twin (TensorBackedModel): the compiled-run cache
         # lives on the tensor instance, so a fresh twin per checker would
@@ -139,19 +144,27 @@ class WavefrontChecker(Checker):
             return dict(self._final_snapshot)
         if self._thread is None:  # sync run already finished
             return dict(self._final_snapshot)
-        self._ckpt_req = self._ckpt_req or threading.Event()
-        self._ckpt_ready.clear()
-        self._ckpt_req.set()
-        # Poll in small increments: the run can finish between our request
-        # and its next checkpoint check, in which case the final snapshot is
-        # the answer and waiting out the full timeout would just stall.
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while not self._ckpt_ready.wait(0.2):
+        with self._ckpt_lock:
+            self._ckpt_req = self._ckpt_req or threading.Event()
+            self._ckpt_ready.clear()
+            self._ckpt_req.set()
+            # Poll in small increments: the run can finish between our
+            # request and its next checkpoint check, in which case the final
+            # snapshot is the answer and waiting out the full timeout would
+            # just stall.
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._ckpt_ready.wait(0.2):
+                if self._done.is_set():
+                    return dict(self._final_snapshot)
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("checkpoint request not served")
+            out, self._ckpt_out = self._ckpt_out, None
+        if out is None:
+            # ready fired without a snapshot: only possible when the run
+            # completed concurrently — surface the final state, never None
             if self._done.is_set():
                 return dict(self._final_snapshot)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError("checkpoint request not served")
-        out, self._ckpt_out = self._ckpt_out, None
+            raise RuntimeError("checkpoint signalled ready without a snapshot")
         return out
 
     def _verify_fingerprint_bridge(self):

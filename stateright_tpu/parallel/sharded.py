@@ -26,8 +26,17 @@ round-trips until the check finishes.  Collective-uniformity note: every
 branch decision inside the loop derives from replicated values (psum/pmax
 results), so all devices always execute the same collective sequence.
 
-Capacities are static; on overflow (table, frontier slice, or route bucket)
-the run restarts with that capacity doubled, as in ``wavefront.py``.
+**Growth without lost work** (same protocol as ``wavefront.py``): every
+capacity is a static shape, but each jitted step is ATOMIC — when a step
+overflows the table, the frontier, or a route bucket, it returns the
+pre-step carry with only the status code advanced.  The host then pulls the
+carry once, grows the offending buffer host-side (rehashing each device's
+table shard independently — fingerprint ownership is capacity-independent,
+so shards never exchange entries during growth — or padding each device's
+frontier segment), and resumes the run through a freshly built engine.
+Counters, discoveries, and the visited set all survive; the overflowing
+wavefront simply replays at the new capacity.  A proactive trigger grows
+the table at 25% shard load before bucket overflows become likely.
 """
 
 from __future__ import annotations
@@ -248,10 +257,11 @@ def _build_sharded_run(
 
     def device_steps(*carry):
         """Up to ``steps`` whole-frontier expansions; returns the carry for
-        the next host sync (live counters, target checks, overflow
-        restarts)."""
+        the next host sync (live counters, target checks, growth).  Each
+        expansion is ATOMIC: on overflow it rolls back to the pre-step carry
+        (status aside) so the host can grow buffers and replay it."""
 
-        def body(carry):
+        def expand(carry):
             (tfp, tpl, cnt, rows, fps, ebits, unique, scount, disc, depth,
              status) = carry
             live = fps != EMPTY
@@ -284,7 +294,11 @@ def _build_sharded_run(
             n_new_g = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
             unique = unique + n_new_g
             foverflow = jax.lax.pmax(n_new > fcap_local, AXIS)
-            toverflow = jax.lax.pmax(toverflow, AXIS)
+            # proactive growth at 25% shard load: past it the Poisson bucket
+            # overflow tail stops being negligible (cf. wavefront.py)
+            used = jnp.sum(cnt.astype(jnp.int64))
+            tthresh = used * jnp.int64(4) > jnp.int64(cap_local)
+            toverflow = jax.lax.pmax(toverflow | tthresh, AXIS)
             status = jnp.where(
                 toverflow,
                 jnp.int32(_TABLE_OVERFLOW),
@@ -297,6 +311,20 @@ def _build_sharded_run(
             depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
             return (tfp, tpl, cnt, nrows, nfps, nebt, unique, scount, disc,
                     depth, status)
+
+        def body(carry):
+            new = expand(carry)
+            status = new[10]
+            # Atomic step: on overflow nothing advances except the status
+            # code, so the host's growth transform resumes from a consistent
+            # carry and the failed wavefront replays losslessly.  (The
+            # visited-table part of the rollback is already guaranteed by
+            # ``bucket_insert`` writing nothing on overflow.)
+            ofl = status != jnp.int32(_OK)
+            rolled = tuple(
+                jnp.where(ofl, old, nxt) for old, nxt in zip(carry[:10], new[:10])
+            )
+            return rolled + (status,)
 
         # Device-local carry components must enter the loop as "varying" over
         # the mesh axis even when their initial value is a replicated constant
@@ -339,11 +367,12 @@ _SHARDED_SNAPSHOT_KEYS = (
 
 class ShardedTpuChecker(WavefrontChecker):
     """Wavefront BFS sharded over a device mesh (TPU ICI on hardware; in tests
-    an 8-device virtual CPU mesh).  Same result surface and restart-on-overflow
-    behavior as the single-device :class:`~.wavefront.TpuChecker`, including
-    mid-run :meth:`checkpoint` / ``spawn_tpu(devices=N, resume=snapshot)``
-    (the mesh width must match: table shards are partitioned by fingerprint
-    ownership)."""
+    an 8-device virtual CPU mesh).  Same result surface and work-preserving
+    growth protocol as the single-device :class:`~.wavefront.TpuChecker`
+    (atomic steps + host-side grow/rehash per shard — no restart, no counter
+    reset), including mid-run :meth:`checkpoint` /
+    ``spawn_tpu(devices=N, resume=snapshot)`` (the mesh width must match:
+    table shards are partitioned by fingerprint ownership)."""
 
     def __init__(
         self,
@@ -372,12 +401,13 @@ class ShardedTpuChecker(WavefrontChecker):
         self._bucket_factor = bucket_factor
         self._steps = steps_per_call
         self._live = (0, 0, 0)  # states, unique, maxdepth
+        # (status, unique-at-boundary) per mid-run growth event; unique is
+        # monotone across events — growth preserves work (tests pin this)
+        self.growth_events: list = []
         self._init_common(options, sync)
 
-    # -- live progress (the single jitted call used to hide everything).
-    # Counters reset when an overflow forces a capacity restart: the restart
-    # genuinely discards the previous attempt's work, and the live surface
-    # reports the run that is actually in progress. -------------------------
+    # -- live progress.  Growth is work-preserving (atomic steps + host-side
+    # buffer transforms), so counters are monotone across growth events. ----
 
     def state_count(self) -> int:
         if self._results:
@@ -427,6 +457,51 @@ class ShardedTpuChecker(WavefrontChecker):
         carry, more, caps = self._final_state
         return self._carry_to_snapshot(carry, more, *caps)
 
+    @staticmethod
+    def _grow_carry(carry_np: list, ndev: int, cap: int, fcap: int, bf: int,
+                    status: int):
+        """Work-preserving growth: transform a consistent (pre-overflow)
+        carry for doubled capacity, host-side.  Table shards rehash
+        independently (ownership is ``(fp >> 32) % D`` — capacity changes
+        only the bucket index *within* a shard); frontier segments pad at
+        their tail (novel rows are front-compacted).  Returns
+        ``(cap, fcap, bf, carry_np)`` with status reset to OK."""
+        from ..ops.buckets import host_bucket_rehash
+
+        if status == _TABLE_OVERFLOW:
+            cap2 = cap * 2
+            tfp = np.asarray(carry_np[0]).reshape(ndev, cap)
+            tpl = np.asarray(carry_np[1]).reshape(ndev, cap)
+            parts = [
+                host_bucket_rehash(tfp[d], tpl[d], cap2 // SLOTS)
+                for d in range(ndev)
+            ]
+            carry_np[0] = np.concatenate([p[0] for p in parts])
+            carry_np[1] = np.concatenate([p[1] for p in parts])
+            carry_np[2] = np.concatenate([p[2] for p in parts])
+            cap = cap2
+        elif status == _FRONTIER_OVERFLOW:
+            fcap2 = fcap * 2
+            width = np.asarray(carry_np[3]).shape[-1]
+            rows = np.asarray(carry_np[3]).reshape(ndev, fcap, width)
+            fps = np.asarray(carry_np[4]).reshape(ndev, fcap)
+            ebt = np.asarray(carry_np[5]).reshape(ndev, fcap)
+            grow = fcap2 - fcap
+            carry_np[3] = np.concatenate(
+                [rows, np.zeros((ndev, grow, width), np.uint64)], axis=1
+            ).reshape(ndev * fcap2, width)
+            carry_np[4] = np.concatenate(
+                [fps, np.full((ndev, grow), EMPTY, np.uint64)], axis=1
+            ).reshape(-1)
+            carry_np[5] = np.concatenate(
+                [ebt, np.zeros((ndev, grow), np.uint32)], axis=1
+            ).reshape(-1)
+            fcap = fcap2
+        elif status == _BUCKET_OVERFLOW:
+            bf *= 2  # route buckets are step-internal; no carry change
+        carry_np[10] = np.int32(_OK)
+        return cap, fcap, bf, carry_np
+
     def _run(self):
         if self._resume is not None:
             # capacities are baked into the compiled programs; adopt the
@@ -441,8 +516,25 @@ class ShardedTpuChecker(WavefrontChecker):
             cache = {}
             self.tensor._sharded_run_cache = cache
         mesh_key = tuple(d.id for d in self.mesh.devices.flat)
-        resume = self._resume
-        while True:  # restart with larger capacities on overflow
+
+        pending = None  # host carry to feed step_fn (resume or post-growth)
+        finished = None  # carry of an already-complete resume snapshot
+        if self._resume is not None:
+            carry0 = [np.asarray(self._resume[k])
+                      for k in _SHARDED_SNAPSHOT_KEYS]
+            st = int(carry0[10])
+            if st != _OK:
+                # snapshot taken at a growth boundary: grow first, then run
+                cap, fcap, bf, carry0 = self._grow_carry(
+                    carry0, self.ndev, cap, fcap, bf, st
+                )
+                pending = carry0
+            elif int(self._resume["more"]):
+                pending = carry0
+            else:
+                finished = carry0
+
+        while True:  # one iteration per engine build (growth rebuilds)
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
             sym = self._symmetry is not None
             key = (mesh_key, cap, fcap, bucket_cap, self._target, sym,
@@ -455,15 +547,15 @@ class ShardedTpuChecker(WavefrontChecker):
                 )
                 cache[key] = fns
             init_fn, step_fn = fns
-            if resume is not None:
-                carry0 = tuple(resume[k] for k in _SHARDED_SNAPSHOT_KEYS)
-                out = step_fn(*carry0) if resume["more"] else (
-                    tuple(jnp.asarray(c) for c in carry0)
-                    + (jnp.int32(0),)
-                )
-                resume = None  # a restart after overflow re-inits fresh
+            from_init = False
+            if finished is not None:
+                out = tuple(jnp.asarray(c) for c in finished) + (jnp.int32(0),)
+            elif pending is not None:
+                out = step_fn(*pending)
+                pending = None
             else:
                 out = init_fn()
+                from_init = True
             while True:
                 # only the replicated scalars cross to the host per sync
                 # (one batched transfer); the sharded carry stays
@@ -485,14 +577,27 @@ class ShardedTpuChecker(WavefrontChecker):
                 if status != _OK or not more or self._stop.is_set():
                     break
                 out = step_fn(*carry)
-            if status == _TABLE_OVERFLOW:
-                cap *= 2
-                continue
-            if status == _FRONTIER_OVERFLOW:
-                fcap *= 2
-                continue
-            if status == _BUCKET_OVERFLOW:
-                bf *= 2
+                from_init = False
+            if status != _OK and not self._stop.is_set():
+                if from_init:
+                    # init overflow: nothing ran yet, so a plain re-init at
+                    # doubled capacity loses no work (device_init is not
+                    # atomic — its frontier compaction truncates)
+                    if status == _TABLE_OVERFLOW:
+                        cap *= 2
+                    elif status == _FRONTIER_OVERFLOW:
+                        fcap *= 2
+                    else:
+                        bf *= 2
+                else:
+                    # mid-run overflow: the atomic step rolled back, so the
+                    # carry is consistent — grow host-side and resume
+                    self.growth_events.append((status, unique))
+                    carry_np = [np.asarray(c) for c in jax.device_get(carry)]
+                    cap, fcap, bf, carry_np = self._grow_carry(
+                        carry_np, self.ndev, cap, fcap, bf, status
+                    )
+                    pending = carry_np
                 continue
             break
         self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf

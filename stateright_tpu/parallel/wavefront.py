@@ -329,8 +329,12 @@ class TpuChecker(WavefrontChecker):
     ``resume`` — a snapshot from :meth:`checkpoint` to continue from.
     ``pallas`` — use the Pallas DMA insert kernel for the visited set
     (``ops/pallas_insert.py``); default is the env knob
-    ``STATERIGHT_TPU_PALLAS=1`` (off otherwise — the XLA windowed-scatter
-    path remains the portable default until the kernel wins on hardware).
+    ``STATERIGHT_TPU_PALLAS=1`` (off otherwise).  Measured on v5e (r3,
+    paxos-3, batch 2048): XLA windowed scatter 233k states/s vs Pallas 102k
+    with exact count parity — the kernel's per-candidate DMA walk is serial
+    where XLA's chunked scatters pipeline, so XLA stays the default on data,
+    not caution.  The bench A/B re-measures every run and reports whichever
+    path wins (``bench.py``).
     Single-device engine only: the sharded engine has its own insert and
     rejects ``pallas=True``.
     """
@@ -361,6 +365,9 @@ class TpuChecker(WavefrontChecker):
         self._resume = resume
         self._live = (0, 0, 0)  # states, unique, maxdepth
         self._live_lock = threading.Lock()
+        # (status, unique-at-boundary) per mid-run growth event; unique is
+        # monotone across events — growth preserves work (tests pin this)
+        self.growth_events: list = []
         self._init_common(options, sync)
 
     # -- run loop ------------------------------------------------------------
@@ -498,7 +505,18 @@ class TpuChecker(WavefrontChecker):
                 int(stats[_ST_MAXDEPTH]), int(stats[_ST_STATUS]),
             )
             disc = stats[_ST_DISC:]
+            with self._live_lock:
+                self._live = (scount, unique, maxdepth)
+            # serve a pending checkpoint BEFORE growing: a request landing on
+            # a growth boundary snapshots the boundary carry (status != OK),
+            # and resume re-applies the growth (the flag travels with the
+            # snapshot — see the resume branch above)
+            if self._ckpt_req is not None and self._ckpt_req.is_set():
+                self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap)
+                self._ckpt_req.clear()
+                self._ckpt_ready.set()
             if status != _STATUS_OK:
+                self.growth_events.append((status, unique))
                 carry_np = [np.asarray(c) for c in carry]
                 cap, qcap, carry_np = self._grow(
                     carry_np, cap, qcap, batch, arity, status
@@ -506,12 +524,6 @@ class TpuChecker(WavefrontChecker):
                 carry = [jnp.asarray(c) for c in carry_np]
                 stats = None
                 continue
-            with self._live_lock:
-                self._live = (scount, unique, maxdepth)
-            if self._ckpt_req is not None and self._ckpt_req.is_set():
-                self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap)
-                self._ckpt_req.clear()
-                self._ckpt_ready.set()
             if self._stop.is_set():
                 break
             done = tail <= head

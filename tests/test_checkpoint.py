@@ -82,6 +82,25 @@ def test_checkpoint_after_completion_is_final_state():
     resumed.assert_properties()
 
 
+def test_growth_boundary_checkpoint_resume():
+    """A snapshot taken at a growth boundary carries ``status != OK``; the
+    resume path must apply the growth (rehash/compact) BEFORE stepping and
+    finish with pinned counts (``wavefront.py`` resume-growth branch).  The
+    engine serves checkpoint requests before growing, so boundary snapshots
+    occur naturally; the boundary statuses are forced here so the test is
+    deterministic."""
+    running = TwoPhaseSys(5).checker().spawn_tpu(batch=64, steps_per_call=2)
+    snap = running.checkpoint(timeout=120.0)
+    running.stop().join()
+    assert 0 < int(snap["unique"]) < 8832, "checkpoint was not mid-run"
+    for status in (2, 1):  # _STATUS_TABLE_FULL (rehash), _STATUS_QUEUE_FULL
+        s = dict(snap)
+        s["status"] = np.int32(status)
+        resumed = TwoPhaseSys(5).checker().spawn_tpu(sync=True, resume=s)
+        assert resumed.unique_state_count() == 8832  # examples/2pc.rs:133
+        resumed.assert_properties()
+
+
 def test_queue_growth_preserves_work():
     # a queue high-water mark far below the state count forces repeated
     # compaction/growth events mid-run; counts must still be exact
