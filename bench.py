@@ -11,13 +11,24 @@ CPU BfsChecker on ``paxos check 3``.  Protocol (mirrors the reference's
     a bounded prefix of ``paxos check 3`` (states/sec is rate-like, so a
     prefix measures it fairly without a multi-hour full Python run), ``2pc
     check 4`` full, and ``2pc check 6`` full.
- 2. TPU phase, run in a SUBPROCESS with a hard wall-clock timeout: the
+ 2. TPU phase, run in SUBPROCESSES with a hard wall-clock budget: the
     axon backend has been observed to hang indefinitely inside PJRT client
     creation, and a hang in-process would mean no benchmark line at all
-    (round 1's failure mode).  The child re-runs the parity configs on
-    device, then times ``paxos check 3`` and ``2pc check 7`` after a warm-up
-    run each (cached XLA executable, standard XLA benchmarking practice).
-    Transient ``UNAVAILABLE`` backend errors are retried once.
+    (round 1's failure mode; round 2 lost the whole phase to ONE 600s init
+    hang).  The orchestration is therefore hang-hostile:
+      - a tiny init-only PROBE child (120s, then 240s) fails fast when the
+        backend is wedged, so full attempts only start against a backend
+        that has proven it can come up;
+      - the full child is retried in FRESH processes until the whole
+        ``BENCH_TPU_TIMEOUT`` budget is spent — a transient init hang costs
+        one watchdog window, not the phase;
+      - the child appends its cumulative results to a stage file after
+        EVERY completed milestone, so a watchdog kill salvages the parity
+        and throughput numbers that did land instead of only stderr marks.
+    The child re-runs the parity configs on device, then times ``paxos
+    check 3`` and ``2pc check 7`` after a warm-up run each (cached XLA
+    executable, standard XLA benchmarking practice).  Transient
+    ``UNAVAILABLE`` backend errors are retried once in-process.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
 — ALWAYS.  On TPU failure/timeout the line still carries the CPU numbers
@@ -179,6 +190,23 @@ def _mark(stage: str) -> None:
     sys.stderr.flush()
 
 
+def _persist(out: dict) -> None:
+    """Append the cumulative result dict to the stage file (if the parent
+    provided one).  A watchdog kill then salvages every number that landed
+    before the hang instead of only stderr stage marks — round 2 lost a
+    whole phase's worth of completed work to exactly that."""
+    path = os.environ.get("BENCH_STAGE_FILE")
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(out) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        pass
+
+
 def tpu_phase() -> dict:
     import threading
 
@@ -200,8 +228,9 @@ def tpu_phase() -> dict:
     threading.Thread(target=heartbeat, daemon=True).start()
 
     _mark("backend-init (jax.devices)")
-    with_tpu_retry(_device_names)
+    out["tpu_devices"] = with_tpu_retry(_device_names)
     _mark("backend-up")
+    _persist(out)
 
     # parity gates on device (capacities sized so no growth event interrupts)
     tpu_p2 = with_tpu_retry(
@@ -220,12 +249,16 @@ def tpu_phase() -> dict:
         )
     out["tpu_paxos2_discoveries"] = sorted(tpu_p2.discoveries())
     out["tpu_2pc5_discoveries"] = sorted(tpu_t5.discoveries())
+    _persist(out)
 
     # primary: paxos check 3 (same model instance across warm-up + timed run
     # so the compiled-run cache on the tensor twin is reused)
     target = os.environ.get("BENCH_TPU_TARGET", "500000")
     m3 = paxos_model(3)
-    caps = dict(capacity=1 << 23, queue_capacity=1 << 21, batch=2048)
+    # tuned on v5e (r3 sweep): batch 2048 beat 1024/3072/4096/8192, and
+    # 1024 device steps per host sync amortizes the ~100ms tunnel RTT
+    caps = dict(capacity=1 << 23, queue_capacity=1 << 21, batch=2048,
+                steps_per_call=1024)
 
     def spawn3():
         b = m3.checker()
@@ -237,6 +270,14 @@ def tpu_phase() -> dict:
     _mark("paxos3 warm-up done")
     tpu_p3, dt = timed(spawn3)
     _mark("paxos3 timed run done")
+    out["tpu_paxos3_states_per_sec"] = round(tpu_p3.state_count() / dt, 1)
+    out["tpu_paxos3_states"] = tpu_p3.state_count()
+    out["tpu_paxos3_unique"] = tpu_p3.unique_state_count()
+    out["tpu_paxos3_sec"] = round(dt, 3)
+    out["tpu_paxos3_discoveries"] = sorted(tpu_p3.discoveries())
+    if target:
+        out["tpu_paxos3_note"] = f"prefix run, target_states={target}"
+    _persist(out)
 
     # A/B the Pallas visited-set insert kernel (ops/pallas_insert.py) on the
     # same primary config; count parity is asserted so a miscompiled kernel
@@ -261,13 +302,7 @@ def tpu_phase() -> dict:
         _mark("paxos3 pallas A/B done")
     except Exception as e:  # noqa: BLE001
         out["tpu_paxos3_pallas_error"] = f"{type(e).__name__}: {e}"
-    out["tpu_paxos3_states_per_sec"] = round(tpu_p3.state_count() / dt, 1)
-    out["tpu_paxos3_states"] = tpu_p3.state_count()
-    out["tpu_paxos3_unique"] = tpu_p3.unique_state_count()
-    out["tpu_paxos3_sec"] = round(dt, 3)
-    out["tpu_paxos3_discoveries"] = sorted(tpu_p3.discoveries())
-    if target:
-        out["tpu_paxos3_note"] = f"prefix run, target_states={target}"
+    _persist(out)
 
     # secondary: 2pc check 7; failure must not void the primary metric, and
     # it is skipped when the phase budget is mostly spent (the parent kills
@@ -276,7 +311,8 @@ def tpu_phase() -> dict:
         if time.monotonic() - t_start > 0.6 * budget:
             raise TimeoutError("phase budget mostly spent; skipping 2pc7")
         t7 = TwoPhaseSys(7)
-        caps7 = dict(capacity=1 << 21, queue_capacity=1 << 19, batch=2048)
+        caps7 = dict(capacity=1 << 21, queue_capacity=1 << 19, batch=2048,
+                     steps_per_call=256)
         t7.checker().spawn_tpu(sync=True, **caps7)  # warm-up
         tpu_t7, dt7 = timed(lambda: t7.checker().spawn_tpu(sync=True, **caps7))
         out["tpu_2pc7_states_per_sec"] = round(tpu_t7.state_count() / dt7, 1)
@@ -285,17 +321,20 @@ def tpu_phase() -> dict:
         out["tpu_2pc7_sec"] = round(dt7, 3)
     except Exception as e:  # noqa: BLE001
         out["tpu_2pc7_error"] = f"{type(e).__name__}: {e}"
+    _persist(out)
 
-    # reference bench protocol on device (configs with a tensor twin); the
-    # lin-reg-3-ordered config has no twin (ordered networks are outside the
-    # compiled fragment) and records its TypeError instead.
+    # reference bench protocol on device.  All five configs compile — the
+    # actor compiler gained ordered-FIFO network support in round 2
+    # (parallel/actor_compiler.py), so lin-reg-3-ordered runs on device too
+    # (pinned by tests/test_network_matrix.py); a failure on any config is
+    # recorded per-tag without voiding the primary metric.
     for tag, build, target in _bench_protocol():
         try:
             if time.monotonic() - t_start > 0.75 * budget:
                 raise TimeoutError("phase budget mostly spent")
             mm = build()
             kw = dict(sync=True, capacity=1 << 21, queue_capacity=1 << 19,
-                      batch=2048)
+                      batch=2048, steps_per_call=256)
             _capped(mm.checker(), target).spawn_tpu(**kw)  # warm-up
             c, dt = timed(
                 lambda: _capped(mm.checker(), target).spawn_tpu(**kw)
@@ -305,8 +344,8 @@ def tpu_phase() -> dict:
             _mark(f"{tag} done")
         except Exception as e:  # noqa: BLE001
             out[f"tpu_{tag}_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
 
-    out["tpu_devices"] = _device_names()
     return out
 
 
@@ -316,11 +355,71 @@ def _device_names() -> list:
     return [str(d) for d in jax.devices()]
 
 
-def run_tpu_subprocess(timeout_s: float) -> dict:
+def _salvage(stage_path: str) -> dict:
+    """Last cumulative result dict the killed child persisted, if any."""
+    try:
+        with open(stage_path) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+        for line in reversed(lines):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    except OSError:
+        pass
+    return {}
+
+
+def run_probe(timeout_s: float) -> tuple:
+    """Init-only child: ``import jax; jax.devices()`` and exit.  Proves the
+    backend can come up WITHOUT committing a long watchdog window to a full
+    attempt.  Returns (ok, seconds, detail)."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tpu-probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        dt = time.monotonic() - t0
+        ok = proc.returncode == 0 and "probe-ok" in proc.stdout
+        detail = (
+            proc.stdout.strip().splitlines()[-1:]
+            + proc.stderr.strip().splitlines()[-2:]
+        )
+        return ok, dt, detail[-1] if detail else ""
+    except subprocess.TimeoutExpired:
+        return False, time.monotonic() - t0, f"probe hung {timeout_s:.0f}s"
+
+
+def run_tpu_subprocess(timeout_s: float, init_s: float = None) -> dict:
     """Run ``tpu_phase`` in a child; a backend hang cannot take down the
     parent's JSON line.  Child stderr goes to a temp file (not a pipe) so
     that even after a timeout-kill the staged progress marks survive and
-    the JSON can say exactly which stage hung."""
+    the JSON can say exactly which stage hung.  The child also persists its
+    cumulative results to a stage file after every milestone; a kill merges
+    that salvage into the returned dict so completed numbers survive."""
+    import tempfile
+
+    if init_s is None:
+        init_s = float(os.environ.get("BENCH_TPU_INIT_TIMEOUT", "300"))
+    stage_fd, stage_path = tempfile.mkstemp(suffix=".bench-stages")
+    os.close(stage_fd)
+    env = dict(os.environ, BENCH_STAGE_FILE=stage_path)
+    try:
+        return _run_tpu_child(timeout_s, init_s, stage_path, env)
+    finally:
+        try:
+            os.unlink(stage_path)
+        except OSError:
+            pass
+
+
+def _run_tpu_child(
+    timeout_s: float, init_s: float, stage_path: str, env: dict
+) -> dict:
     import tempfile
 
     with tempfile.TemporaryFile(mode="w+", errors="replace") as errf:
@@ -330,6 +429,7 @@ def run_tpu_subprocess(timeout_s: float) -> dict:
             stderr=errf,
             text=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
         )
 
         def read_err() -> list:
@@ -351,13 +451,13 @@ def run_tpu_subprocess(timeout_s: float) -> dict:
                     stage = line.split(":", 1)[1].strip()
             return stage
 
-        # Backend-init watchdog on top of the total budget: the axon backend
-        # has been observed to block 25+ minutes inside PJRT client creation
-        # before failing UNAVAILABLE.  If the child is still in backend-init
-        # after BENCH_TPU_INIT_TIMEOUT, kill it early so the CPU numbers
-        # emit without waiting out the whole budget (a healthy init is <60s;
-        # later stages run long legitimately, so only init gets this limit).
-        init_s = float(os.environ.get("BENCH_TPU_INIT_TIMEOUT", "600"))
+        # Backend-init watchdog on top of the per-attempt budget: the axon
+        # backend has been observed to block 25+ minutes inside PJRT client
+        # creation before failing UNAVAILABLE.  If the child is still in
+        # backend-init after ``init_s``, kill it early — the caller's retry
+        # loop relaunches a fresh child with the remaining phase budget
+        # (a healthy init is <60s; later stages run long legitimately, so
+        # only init gets this limit).
         deadline = time.monotonic() + timeout_s
         t0 = time.monotonic()
         init_passed = False
@@ -384,22 +484,97 @@ def run_tpu_subprocess(timeout_s: float) -> dict:
                     )
                     proc.kill()
                     proc.communicate()
-                    return {
-                        "error": f"TPU phase {why}",
-                        "tpu_trace_tail": err_tail(),
-                    }
+                    res = _salvage(stage_path)
+                    res.update(
+                        error=f"TPU phase {why}",
+                        tpu_stuck_init=stuck_init,
+                        tpu_trace_tail=err_tail(),
+                    )
+                    return res
         for line in reversed(stdout.strip().splitlines()):
             try:
                 return json.loads(line)
             except json.JSONDecodeError:
                 continue
-        return {
-            "error": f"TPU phase exited rc={proc.returncode} without JSON",
-            "tpu_trace_tail": err_tail() or stdout.strip().splitlines()[-8:],
-        }
+        res = _salvage(stage_path)
+        res.update(
+            error=f"TPU phase exited rc={proc.returncode} without JSON",
+            tpu_trace_tail=err_tail() or stdout.strip().splitlines()[-8:],
+        )
+        return res
+
+
+def run_tpu_with_budget(budget_s: float) -> dict:
+    """Spend the ENTIRE TPU budget trying to land numbers — never one
+    attempt.  Phase A: cheap init-only probes (120s, escalating) until the
+    backend proves it can come up (bounded to ~40% of budget).  Phase B:
+    full attempts in fresh child processes, each under an init watchdog,
+    relaunching on init hangs until the budget is spent.  Results from a
+    killed attempt are salvaged from its stage file and merged, so the
+    best partial data across all attempts survives.  ``tpu_attempts``
+    records every attempt for the log-of-evidence case where the backend
+    never comes up at all."""
+    t0 = time.monotonic()
+    attempts: list = []
+    merged: dict = {}
+
+    def remaining() -> float:
+        return budget_s - (time.monotonic() - t0)
+
+    # Phase A: probes.  An init hang costs one probe window, not 600s.
+    probe_s = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
+    probe_budget = 0.4 * budget_s
+    while time.monotonic() - t0 < probe_budget and remaining() > 90:
+        ok, dt, detail = run_probe(min(probe_s, remaining() - 60))
+        attempts.append(
+            {"kind": "probe", "ok": ok, "sec": round(dt, 1),
+             "detail": str(detail)}
+        )
+        sys.stderr.write(f"bench: probe ok={ok} in {dt:.0f}s: {detail}\n")
+        if ok:
+            break
+        probe_s = min(probe_s * 2, 480.0)
+        time.sleep(10)  # let a stale chip lock from the killed probe clear
+
+    # Phase B: full attempts until the budget is spent (or a deterministic
+    # failure makes retrying pointless).
+    transient = ("init", "UNAVAILABLE", "ALREADY_EXISTS", "hung",
+                 "without JSON")
+    while remaining() > 60 and len(attempts) < 24:
+        res = run_tpu_subprocess(remaining())
+        stuck = bool(res.pop("tpu_stuck_init", False))
+        err = res.get("error")
+        attempts.append(
+            {"kind": "full", "ok": err is None, "stuck_init": stuck,
+             "error": err}
+        )
+        sys.stderr.write(f"bench: full attempt ok={err is None}: {err}\n")
+        if err is None:
+            merged.pop("error", None)
+            merged.pop("tpu_trace_tail", None)
+        merged.update(res)
+        if err is None or "tpu_paxos3_states_per_sec" in merged:
+            break  # success, or the primary metric already landed
+        if not (stuck or any(t in err for t in transient)):
+            break  # deterministic failure — a fresh child won't differ
+        time.sleep(10)
+
+    merged["tpu_attempts"] = attempts
+    if not any(a["kind"] == "full" for a in attempts):
+        merged.setdefault(
+            "error",
+            "TPU backend never initialized: all probe attempts hung "
+            "(see tpu_attempts)",
+        )
+    return merged
 
 
 def main() -> int:
+    if "--tpu-probe" in sys.argv:
+        import jax
+
+        print("probe-ok", [str(d) for d in jax.devices()])
+        return 0
     if "--tpu-child" in sys.argv:
         try:
             print(json.dumps(tpu_phase()))
@@ -415,7 +590,7 @@ def main() -> int:
 
     extras = cpu_phase()
     timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
-    extras.update(run_tpu_subprocess(timeout_s))
+    extras.update(run_tpu_with_budget(timeout_s))
 
     for w in ("paxos2", "2pc5"):
         cpu_d = extras.get(f"cpu_{w}_discoveries")
