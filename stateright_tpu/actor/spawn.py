@@ -34,6 +34,7 @@ class SpawnedActor:
         self.id = id
         self.actor = actor
         self.thread: Optional[threading.Thread] = None
+        self.sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self.state = None  # exposed for tests/debugging
 
@@ -46,10 +47,7 @@ class SpawnedActor:
 
 
 def _run(handle: SpawnedActor) -> None:
-    actor, id = handle.actor, handle.id
-    ip, port = id.to_addr()
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    sock.bind((ip, port))
+    actor, id, sock = handle.actor, handle.id, handle.sock
     try:
         out = Out()
         state = actor.on_start(id, out)
@@ -135,12 +133,28 @@ def spawn(
     with ``background=False`` blocks until all threads exit.
     """
     handles = []
-    for id, actor in actors:
-        handle = SpawnedActor(Id(id), actor)
-        handle.thread = threading.Thread(
-            target=_run, args=(handle,), daemon=True
-        )
-        handles.append(handle)
+    try:
+        for id, actor in actors:
+            handle = SpawnedActor(Id(id), actor)
+            # bind synchronously: callers may send to the actor the moment
+            # spawn() returns, and a bind failure should raise here, not die
+            # silently inside a daemon thread
+            ip, port = handle.id.to_addr()
+            handle.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            handles.append(handle)  # appended first so a bind failure below
+            #                         still closes this handle's socket
+            handle.sock.bind((ip, port))
+            handle.thread = threading.Thread(
+                target=_run, args=(handle,), daemon=True
+            )
+    except OSError:
+        # partial failure: no thread has started yet (so no _run/finally
+        # will close anything) — release every socket bound so far, or the
+        # ports stay stuck until GC
+        for h in handles:
+            if h.sock is not None:
+                h.sock.close()
+        raise
     for h in handles:
         h.thread.start()
     if not background:
