@@ -63,9 +63,17 @@ class Path(Generic[State, Action]):
             # the walk backtracks over matching members
             greedy = False
 
-        def matches(state, want):
+        # members proven to dead-end, per depth: whether a member can match
+        # the remaining suffix depends only on (member, depth), never on the
+        # prefix that reached it — so a dead-end holds across alternatives,
+        # and skipping it bounds the backtracking walk at O(members × depth)
+        # instead of worst-case exponential re-exploration
+        dead: dict[int, set] = {}
+
+        def matches(state, want, depth):
             out = []
             seen_members = set()
+            blocked = dead.get(depth, ())
             for action in model.actions(state):
                 nxt = model.next_state(state, action)
                 if nxt is not None and key(nxt) == want:
@@ -75,7 +83,7 @@ class Path(Generic[State, Action]):
                     # keep one per member or backtracking re-explores the
                     # same dead-end subtree per duplicate
                     member = model.fingerprint_state(nxt)
-                    if member not in seen_members:
+                    if member not in seen_members and member not in blocked:
                         seen_members.add(member)
                         out.append((action, nxt))
             return out
@@ -94,7 +102,11 @@ class Path(Generic[State, Action]):
             if not cands:
                 stack.pop()
                 if chosen:
-                    chosen.pop()
+                    popped = chosen.pop()
+                    if not greedy:  # every continuation failed: dead-end
+                        dead.setdefault(depth - 1, set()).add(
+                            model.fingerprint_state(popped[1])
+                        )
                 continue
             act_nxt = cands.pop(0)
             chosen.append(act_nxt)
@@ -105,11 +117,15 @@ class Path(Generic[State, Action]):
                     pairs.append((chosen[i][1], chosen[i + 1][0]))
                 pairs.append((chosen[-1][1], None))
                 return Path(pairs)
-            nxt_cands = matches(act_nxt[1], fps[depth + 1])
+            nxt_cands = matches(act_nxt[1], fps[depth + 1], depth + 1)
             if nxt_cands:
                 stack.append((depth + 1, nxt_cands))
             else:
                 chosen.pop()
+                if not greedy:  # no viable continuation at all: dead-end
+                    dead.setdefault(depth, set()).add(
+                        model.fingerprint_state(act_nxt[1])
+                    )
         if not greedy:
             raise RuntimeError(
                 "Failed to reconstruct a symmetry-reduced path: no sequence "
