@@ -96,14 +96,19 @@ class CheckerBuilder:
 
         return TpuChecker(self, **kw)
 
-    def serve(self, addr: str = "localhost:3000"):
-        """Spawn a BFS check and serve the Explorer web UI over it
-        (reference ``checker.rs:108-114``)."""
+    def serve(
+        self, addr: str = "localhost:3000", strategy: str = "bfs", **spawn_kw
+    ):
+        """Spawn a check and serve the Explorer web UI over it (reference
+        ``checker.rs:108-114``).  ``strategy="tpu"`` browses a device
+        wavefront run (beyond the reference, whose Explorer wraps only
+        ``BfsChecker``); with it, extra keyword arguments pass through to
+        ``spawn_tpu``."""
         try:
             from ..explorer import serve
         except ImportError as e:
             raise NotImplementedError("the Explorer is not available yet") from e
-        return serve(self, addr)
+        return serve(self, addr, strategy=strategy, **spawn_kw)
 
 
 class Checker:

@@ -85,6 +85,21 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
                     model, checker._trace(fp)
                 ).encode(model)
             encoded[name] = _ENCODED_CACHE[key]
+    elif hasattr(checker, "live_discoveries"):
+        # device engines: discovery fps ride the per-sync stats, paths
+        # parent-walk a checkpointed table + re-execute the object form.
+        # First-wins discovery fps never change, so reconstruction happens
+        # once per discovery: cached names are passed as ``skip`` and the
+        # engine takes no checkpoint at all when nothing new is recorded.
+        encoded = {
+            name: _ENCODED_CACHE[(id(checker), name)]
+            for name in (p.name for p in model.properties())
+            if (id(checker), name) in _ENCODED_CACHE
+        }
+        fresh = checker.live_discoveries(skip=frozenset(encoded))
+        for name, path in fresh.items():
+            _ENCODED_CACHE[(id(checker), name)] = path.encode(model)
+            encoded[name] = _ENCODED_CACHE[(id(checker), name)]
     else:  # other strategies: full (joining) reconstruction
         encoded = {
             name: path.encode(model)
@@ -246,12 +261,37 @@ def _make_handler(model, checker, snapshot: _Snapshot):
 
 
 class ExplorerServer:
-    """A running Explorer; ``addr`` like ``"localhost:3000"``."""
+    """A running Explorer; ``addr`` like ``"localhost:3000"``.
 
-    def __init__(self, builder, addr: str = "localhost:3000"):
+    ``strategy`` — ``"bfs"`` (default; reference parity: the reference
+    Explorer wraps only ``BfsChecker``, ``explorer.rs:85-88``) or ``"tpu"``:
+    the device wavefront engine, with live ``/.status`` counters and
+    discovery paths reconstructed by parent-walk + object-form re-execution
+    (``/.states`` re-executes the object model either way, so browsing is
+    identical)."""
+
+    def __init__(
+        self,
+        builder,
+        addr: str = "localhost:3000",
+        strategy: str = "bfs",
+        **spawn_kw,
+    ):
         host, _, port = addr.partition(":")
         self.snapshot = _Snapshot()
-        self.checker = builder.visitor(self.snapshot).spawn_bfs()
+        if strategy == "tpu":
+            # no per-state visitor on device (states never materialize);
+            # recent_path stays empty, the counters are live
+            self.checker = builder.spawn_tpu(**spawn_kw)
+        elif strategy == "bfs":
+            if spawn_kw:
+                raise TypeError(
+                    "spawn keyword arguments are only supported with "
+                    f"strategy='tpu' (got {sorted(spawn_kw)})"
+                )
+            self.checker = builder.visitor(self.snapshot).spawn_bfs()
+        else:
+            raise ValueError(f"unknown Explorer strategy {strategy!r}")
         self.model = builder.model
         handler = _make_handler(self.model, self.checker, self.snapshot)
         self.httpd = ThreadingHTTPServer((host, int(port or "3000")), handler)
@@ -271,10 +311,18 @@ class ExplorerServer:
         self.httpd.server_close()
 
 
-def serve(builder, addr: str = "localhost:3000", block: bool = True):
-    """Spawn a BFS check over ``builder`` and serve the Explorer UI
-    (reference ``checker.rs:108-114``)."""
-    server = ExplorerServer(builder, addr)
+def serve(
+    builder,
+    addr: str = "localhost:3000",
+    block: bool = True,
+    strategy: str = "bfs",
+    **spawn_kw,
+):
+    """Spawn a check over ``builder`` and serve the Explorer UI
+    (reference ``checker.rs:108-114``).  ``strategy="tpu"`` serves a device
+    wavefront run instead of host BFS; with it, extra keyword arguments pass
+    through to ``spawn_tpu`` (e.g. ``batch=...``)."""
+    server = ExplorerServer(builder, addr, strategy=strategy, **spawn_kw)
     if block:
         server.serve_forever()
         return server

@@ -212,34 +212,47 @@ class WavefrontChecker(Checker):
             np.asarray(self._results["table_parent"]),
         )
 
-    def _parents(self) -> dict[int, int]:
-        if self._parent_map is None:
-            tfp, tpl = self._table_np()
-            occupied = tfp != np.uint64(MASK64)
-            self._parent_map = dict(
-                zip(tfp[occupied].tolist(), tpl[occupied].tolist())
-            )
-        return self._parent_map
+    @staticmethod
+    def _parents_from_table(tfp: np.ndarray, tpl: np.ndarray) -> dict[int, int]:
+        """fp -> parent fp map from table arrays (shared by the joined and
+        live paths so the occupancy/root encodings live in one place)."""
+        tfp = np.asarray(tfp).reshape(-1)
+        tpl = np.asarray(tpl).reshape(-1)
+        occupied = tfp != np.uint64(MASK64)
+        return dict(zip(tfp[occupied].tolist(), tpl[occupied].tolist()))
 
-    def _trace(self, fp: int) -> list[int]:
-        parents = self._parents()
+    @staticmethod
+    def _walk(parents: dict[int, int], fp: int) -> list[int]:
+        """Parent chain from an init state down to ``fp`` (0 marks "is an
+        init state")."""
         fps = [fp]
         while True:
             parent = parents.get(fps[-1], 0)
-            if parent == 0:  # 0 marks "is an init state"
+            if parent == 0:
                 break
             fps.append(parent)
         fps.reverse()
         return fps
 
+    def _parents(self) -> dict[int, int]:
+        if self._parent_map is None:
+            self._parent_map = self._parents_from_table(*self._table_np())
+        return self._parent_map
+
+    def _trace(self, fp: int) -> list[int]:
+        return self._walk(self._parents(), fp)
+
+    def _symmetry_key(self):
+        if self._symmetry is None:
+            return None
+        # device traces record canonical fingerprints; match classes
+        sym, model = self._symmetry, self.model
+        return lambda s: model.fingerprint_state(sym(s))
+
     def discoveries(self) -> dict[str, Path]:
         self.join()
         disc = self._results["disc"]
-        key = None
-        if self._symmetry is not None:
-            # device traces record canonical fingerprints; match classes
-            sym, model = self._symmetry, self.model
-            key = lambda s: model.fingerprint_state(sym(s))  # noqa: E731
+        key = self._symmetry_key()
         out = {}
         for i, prop in enumerate(self._props):
             fp = int(disc[i])
@@ -247,4 +260,51 @@ class WavefrontChecker(Checker):
                 out[prop.name] = Path.from_fingerprints(
                     self.model, self._trace(fp), key=key
                 )
+        return out
+
+    def live_discoveries(self, skip: frozenset = frozenset()) -> dict[str, Path]:
+        """Discoveries visible so far WITHOUT joining: the Explorer polls
+        this while the device run is still in flight.  Discovery
+        fingerprints ride the per-sync stats vector; the parent chain of a
+        recorded discovery is immutable once written, so a one-off
+        :meth:`checkpoint` (served at the next host sync) provides a table
+        snapshot sufficient to parent-walk it.  ``skip`` names properties
+        the caller has already reconstructed (first-wins fps never change):
+        when every recorded discovery is in ``skip``, no checkpoint is taken
+        at all, keeping repeated polls free."""
+        if self._done.is_set():
+            return {
+                n: p for n, p in self.discoveries().items() if n not in skip
+            }
+        disc = getattr(self, "_live_disc", None)
+        if disc is None:
+            return {}
+        disc = np.asarray(disc)
+        wanted = [
+            (i, prop)
+            for i, prop in enumerate(self._props)
+            if prop.name not in skip and int(disc[i]) != 0
+        ]
+        if not wanted:
+            return {}
+        try:
+            snap = self.checkpoint(timeout=30.0)
+        except (TimeoutError, RuntimeError):
+            return {}
+        if self._done.is_set():  # finished while we snapshotted
+            return {
+                n: p for n, p in self.discoveries().items() if n not in skip
+            }
+        parents = self._parents_from_table(
+            snap["table_fp"], snap["table_parent"]
+        )
+        key = self._symmetry_key()
+        out = {}
+        for i, prop in wanted:
+            try:
+                out[prop.name] = Path.from_fingerprints(
+                    self.model, self._walk(parents, int(disc[i])), key=key
+                )
+            except RuntimeError:
+                continue  # chain raced a growth boundary; next poll retries
         return out

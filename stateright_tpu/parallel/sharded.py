@@ -561,13 +561,15 @@ class ShardedTpuChecker(WavefrontChecker):
                 # (one batched transfer); the sharded carry stays
                 # device-resident between calls
                 carry = out[:11]
+                unique, scount, depth, status, more, disc = jax.device_get(
+                    (out[6], out[7], out[9], out[10], out[11], out[8])
+                )
                 unique, scount, depth, status, more = (
-                    int(x)
-                    for x in jax.device_get(
-                        (out[6], out[7], out[9], out[10], out[11])
-                    )
+                    int(unique), int(scount), int(depth), int(status),
+                    int(more),
                 )
                 self._live = (scount, unique, depth)
+                self._live_disc = np.asarray(disc)
                 if self._ckpt_req is not None and self._ckpt_req.is_set():
                     self._ckpt_out = self._carry_to_snapshot(
                         carry, more, cap, fcap, bf

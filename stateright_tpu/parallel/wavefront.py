@@ -507,6 +507,7 @@ class TpuChecker(WavefrontChecker):
             disc = stats[_ST_DISC:]
             with self._live_lock:
                 self._live = (scount, unique, maxdepth)
+                self._live_disc = np.asarray(disc)
             # serve a pending checkpoint BEFORE growing: a request landing on
             # a growth boundary snapshots the boundary carry (status != OK),
             # and resume re-applies the growth (the flag travels with the
