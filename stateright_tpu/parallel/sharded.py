@@ -83,6 +83,7 @@ _OK = 0
 _FRONTIER_OVERFLOW = 1
 _TABLE_OVERFLOW = 2
 _BUCKET_OVERFLOW = 3
+_CAND_OVERFLOW = 4  # valid candidates exceeded the compaction budget
 
 AXIS = "d"
 
@@ -97,8 +98,14 @@ def _build_sharded_run(
     target: Optional[int],
     sym: bool = False,
     steps: int = 16,
+    cand_local: Optional[int] = None,
 ):
-    """Build the jitted whole-run shard_map for fixed per-device capacities."""
+    """Build the jitted whole-run shard_map for fixed per-device capacities.
+
+    ``cand_local`` is the per-device valid-candidate compaction budget for
+    the owner-side insert (see ``bucket_insert``); a step whose routed
+    candidates exceed it reports ``_CAND_OVERFLOW`` atomically and the host
+    doubles the budget and replays."""
     ndev = mesh.shape[AXIS]
     width, arity = tensor.width, tensor.max_actions
     n_props = len(props)
@@ -111,6 +118,8 @@ def _build_sharded_run(
     init_rows_np = np.asarray(tensor.init_rows(), dtype=np.uint64)
     n_init = init_rows_np.shape[0]
     m_cand = fcap_local * arity
+    if cand_local is not None:
+        cand_local = min(cand_local, ndev * bucket_cap)
 
     def owner_of(fps):
         return ((fps >> jnp.uint64(32)) % jnp.uint64(ndev)).astype(jnp.int32)
@@ -185,19 +194,23 @@ def _build_sharded_run(
     # -- owner-side dedup + insert + compaction ------------------------------
 
     def insert_and_compact(tfp, tpl, cnt, cand_rows, cand_fp, cand_par,
-                           cand_ebits):
+                           cand_ebits, compact=None):
         """Dedup candidates, claim table slots (bucketized one-shot insert —
         same visited-set as the single-device engine, ``ops/buckets.py``;
         the round-1 probe-loop ``hash_insert`` cost a full-size scatter per
         probe iteration on real TPU), compact novel rows into a
-        frontier-shaped (exactly ``fcap_local``-row) buffer."""
+        frontier-shaped (exactly ``fcap_local``-row) buffer.  ``compact``
+        is the valid-candidate budget (see ``bucket_insert``) — the insert
+        pipeline runs at that width instead of the padded receive size."""
         m = cand_fp.shape[0]
-        tfp, tpl, cnt, order, perm, novel, n_new, toverflow = bucket_insert(
+        tfp, tpl, cnt, sel, n_new, toverflow, coverflow = bucket_insert(
             tfp, tpl, cnt, cand_fp, cand_par,
             window=min(m, max(64, fcap_local)), generation_order=sym,
+            compact=compact,
         )
-        take = min(m, fcap_local)  # fewer candidates than frontier slots is fine
-        sel = order[perm][:take]  # original indices, novel-compacted
+        sel_w = sel.shape[0]
+        take = min(sel_w, fcap_local)
+        sel = sel[:take]  # original indices, novel-compacted
         nrows = cand_rows[sel]
         nfps = jnp.where(jnp.arange(take) < n_new, cand_fp[sel], EMPTY)
         nebt = cand_ebits[sel]
@@ -206,7 +219,7 @@ def _build_sharded_run(
             nrows = jnp.concatenate([nrows, jnp.zeros((pad, width), jnp.uint64)])
             nfps = jnp.concatenate([nfps, jnp.full((pad,), EMPTY, jnp.uint64)])
             nebt = jnp.concatenate([nebt, jnp.zeros((pad,), jnp.uint32)])
-        return tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow
+        return tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow, coverflow
 
     # -- the per-device program ----------------------------------------------
 
@@ -225,8 +238,9 @@ def _build_sharded_run(
         cand_fp = jnp.where(mine, ifp, EMPTY)
         cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = init state
         cand_ebt = jnp.full((n_init,), init_ebits, jnp.uint32)
-        tfp, tpl, cnt, rows0, fps0, ebt0, n_new, toverflow = insert_and_compact(
-            tfp, tpl, cnt, irows, cand_fp, cand_par, cand_ebt
+        tfp, tpl, cnt, rows0, fps0, ebt0, n_new, toverflow, _ = (
+            insert_and_compact(tfp, tpl, cnt, irows, cand_fp, cand_par,
+                               cand_ebt)
         )
         unique = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
         foverflow = n_new > fcap_local
@@ -288,12 +302,14 @@ def _build_sharded_run(
             rfp, rrows, rpar, rebt, boverflow = route(
                 cand_fp, cand_rows, cand_par, cand_ebt
             )
-            tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow = (
-                insert_and_compact(tfp, tpl, cnt, rrows, rfp, rpar, rebt)
+            tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow, coverflow = (
+                insert_and_compact(tfp, tpl, cnt, rrows, rfp, rpar, rebt,
+                                   compact=cand_local)
             )
             n_new_g = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
             unique = unique + n_new_g
             foverflow = jax.lax.pmax(n_new > fcap_local, AXIS)
+            coverflow = jax.lax.pmax(coverflow, AXIS)
             # proactive growth at 25% shard load: past it the Poisson bucket
             # overflow tail stops being negligible (cf. wavefront.py)
             used = jnp.sum(cnt.astype(jnp.int64))
@@ -303,9 +319,17 @@ def _build_sharded_run(
                 toverflow,
                 jnp.int32(_TABLE_OVERFLOW),
                 jnp.where(
-                    boverflow,
-                    jnp.int32(_BUCKET_OVERFLOW),
-                    jnp.where(foverflow, jnp.int32(_FRONTIER_OVERFLOW), status),
+                    coverflow,
+                    jnp.int32(_CAND_OVERFLOW),
+                    jnp.where(
+                        boverflow,
+                        jnp.int32(_BUCKET_OVERFLOW),
+                        jnp.where(
+                            foverflow,
+                            jnp.int32(_FRONTIER_OVERFLOW),
+                            status,
+                        ),
+                    ),
                 ),
             )
             depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
@@ -382,6 +406,7 @@ class ShardedTpuChecker(WavefrontChecker):
         capacity: int = 1 << 17,
         frontier_capacity: int = 1 << 13,
         bucket_factor: int = 2,
+        cand_factor: int = 4,
         sync: bool = False,
         pallas: Optional[bool] = None,
         steps_per_call: int = 16,
@@ -399,6 +424,10 @@ class ShardedTpuChecker(WavefrontChecker):
         self._cap_local = max(64, _pow2(capacity // self.ndev))
         self._fcap_local = max(16, frontier_capacity // self.ndev)
         self._bucket_factor = bucket_factor
+        # valid-candidate budget per device = cand_factor * fcap_local
+        # (doubled on demand): the owner-side insert pipeline runs at this
+        # width instead of the padded all-to-all receive size
+        self._cand_factor = cand_factor
         self._steps = steps_per_call
         self._live = (0, 0, 0)  # states, unique, maxdepth
         # (status, unique-at-boundary) per mid-run growth event; unique is
@@ -436,7 +465,7 @@ class ShardedTpuChecker(WavefrontChecker):
 
     _engine_tag = "sharded"
 
-    def _carry_to_snapshot(self, carry, more, cap, fcap, bf) -> dict:
+    def _carry_to_snapshot(self, carry, more, cap, fcap, bf, cf) -> dict:
         snap = {
             k: np.asarray(v)
             for k, v in zip(_SHARDED_SNAPSHOT_KEYS, carry)
@@ -446,6 +475,7 @@ class ShardedTpuChecker(WavefrontChecker):
         snap["cap_local"] = cap
         snap["fcap_local"] = fcap
         snap["bucket_factor"] = bf
+        snap["cand_factor"] = cf
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
         return snap
@@ -459,13 +489,15 @@ class ShardedTpuChecker(WavefrontChecker):
 
     @staticmethod
     def _grow_carry(carry_np: list, ndev: int, cap: int, fcap: int, bf: int,
-                    status: int):
+                    cf: int, status: int):
         """Work-preserving growth: transform a consistent (pre-overflow)
         carry for doubled capacity, host-side.  Table shards rehash
         independently (ownership is ``(fp >> 32) % D`` — capacity changes
         only the bucket index *within* a shard); frontier segments pad at
-        their tail (novel rows are front-compacted).  Returns
-        ``(cap, fcap, bf, carry_np)`` with status reset to OK."""
+        their tail (novel rows are front-compacted); the route-bucket and
+        candidate budgets are engine parameters (step-internal buffers), so
+        growing them needs no carry change at all.  Returns
+        ``(cap, fcap, bf, cf, carry_np)`` with status reset to OK."""
         from ..ops.buckets import host_bucket_rehash
 
         if status == _TABLE_OVERFLOW:
@@ -498,9 +530,11 @@ class ShardedTpuChecker(WavefrontChecker):
             ).reshape(-1)
             fcap = fcap2
         elif status == _BUCKET_OVERFLOW:
-            bf *= 2  # route buckets are step-internal; no carry change
+            bf *= 2
+        elif status == _CAND_OVERFLOW:
+            cf *= 2
         carry_np[10] = np.int32(_OK)
-        return cap, fcap, bf, carry_np
+        return cap, fcap, bf, cf, carry_np
 
     def _run(self):
         if self._resume is not None:
@@ -509,7 +543,9 @@ class ShardedTpuChecker(WavefrontChecker):
             self._cap_local = int(self._resume["cap_local"])
             self._fcap_local = int(self._resume["fcap_local"])
             self._bucket_factor = int(self._resume["bucket_factor"])
+            self._cand_factor = int(self._resume.get("cand_factor", 4))
         cap, fcap, bf = self._cap_local, self._fcap_local, self._bucket_factor
+        cf = self._cand_factor
         arity = self.tensor.max_actions
         cache = getattr(self.tensor, "_sharded_run_cache", None)
         if cache is None:
@@ -525,8 +561,8 @@ class ShardedTpuChecker(WavefrontChecker):
             st = int(carry0[10])
             if st != _OK:
                 # snapshot taken at a growth boundary: grow first, then run
-                cap, fcap, bf, carry0 = self._grow_carry(
-                    carry0, self.ndev, cap, fcap, bf, st
+                cap, fcap, bf, cf, carry0 = self._grow_carry(
+                    carry0, self.ndev, cap, fcap, bf, cf, st
                 )
                 pending = carry0
             elif int(self._resume["more"]):
@@ -536,14 +572,16 @@ class ShardedTpuChecker(WavefrontChecker):
 
         while True:  # one iteration per engine build (growth rebuilds)
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
+            cand_local = max(64, cf * fcap)
             sym = self._symmetry is not None
-            key = (mesh_key, cap, fcap, bucket_cap, self._target, sym,
-                   self._steps)
+            key = (mesh_key, cap, fcap, bucket_cap, cand_local, self._target,
+                   sym, self._steps)
             fns = cache.get(key)
             if fns is None:
                 fns = _build_sharded_run(
                     self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
                     self._target, sym=sym, steps=self._steps,
+                    cand_local=cand_local,
                 )
                 cache[key] = fns
             init_fn, step_fn = fns
@@ -572,7 +610,7 @@ class ShardedTpuChecker(WavefrontChecker):
                 self._live_disc = np.asarray(disc)
                 if self._ckpt_req is not None and self._ckpt_req.is_set():
                     self._ckpt_out = self._carry_to_snapshot(
-                        carry, more, cap, fcap, bf
+                        carry, more, cap, fcap, bf, cf
                     )
                     self._ckpt_req.clear()
                     self._ckpt_ready.set()
@@ -589,6 +627,8 @@ class ShardedTpuChecker(WavefrontChecker):
                         cap *= 2
                     elif status == _FRONTIER_OVERFLOW:
                         fcap *= 2
+                    elif status == _CAND_OVERFLOW:
+                        cf *= 2
                     else:
                         bf *= 2
                 else:
@@ -596,13 +636,14 @@ class ShardedTpuChecker(WavefrontChecker):
                     # carry is consistent — grow host-side and resume
                     self.growth_events.append((status, unique))
                     carry_np = [np.asarray(c) for c in jax.device_get(carry)]
-                    cap, fcap, bf, carry_np = self._grow_carry(
-                        carry_np, self.ndev, cap, fcap, bf, status
+                    cap, fcap, bf, cf, carry_np = self._grow_carry(
+                        carry_np, self.ndev, cap, fcap, bf, cf, status
                     )
                     pending = carry_np
                 continue
             break
         self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf
+        self._cand_factor = cf
         self._results = {
             "unique": unique,
             "states": scount,
@@ -613,7 +654,7 @@ class ShardedTpuChecker(WavefrontChecker):
         }
         # keep the final carry device-resident; a stopped run's snapshot
         # keeps more=1 so resume continues it (see _final_snapshot)
-        self._final_state = (carry, more, (cap, fcap, bf))
+        self._final_state = (carry, more, (cap, fcap, bf, cf))
         self._done.set()
 
 

@@ -74,6 +74,7 @@ from ._base import WavefrontChecker
 _STATUS_OK = 0
 _STATUS_QUEUE_FULL = 1
 _STATUS_TABLE_FULL = 2
+_STATUS_CAND_FULL = 3  # valid candidates exceeded the compaction budget
 
 # Carry tuple indices (shared by the jitted program and the host loop).
 _TFP, _TPL, _CNT, _QROWS, _QFP, _QEBITS, _QDEPTH = 0, 1, 2, 3, 4, 5, 6
@@ -105,15 +106,23 @@ def _stats_np(carry) -> np.ndarray:
 
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
-                  sym: bool = False):
+                  sym: bool = False, cand: Optional[int] = None):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
     one batch's worth of candidates (``m``) so the dynamic slice/update at
     ``head``/``tail`` is always in bounds without clamping.
+
+    ``cand`` is the valid-candidate compaction budget per batch (see
+    ``ops/buckets.bucket_insert``): the insert pipeline runs at this width
+    instead of the padded ``batch * arity``.  A batch whose enabled-action
+    count exceeds it reports ``_STATUS_CAND_FULL`` without writing anything
+    and the host doubles the budget and replays — self-tuning, like the
+    other capacities.
     """
     width, arity = tensor.width, tensor.max_actions
     m = batch * arity
+    eff_cand = min(cand, m) if cand else m
     qalloc = qcap + m
     n_props = len(props)
     ev_idx = [
@@ -193,27 +202,30 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             depths[:, None] + jnp.uint32(1), (batch, arity)
         ).reshape(-1)
 
-        tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
+        # window stays at ``batch`` (measured: one cand-wide loop iteration
+        # is SLOWER than 2-3 batch-wide ones — wide iterations pay for dead
+        # lanes; the compaction budget only bounds the pipeline width)
+        tfp, tpl, cnt, sel, n_new, toverflow, coverflow = bucket_insert(
             tfp, tpl, cnt, cand_fp, cand_par, window=batch,
-            use_pallas=pallas, generation_order=sym,
+            use_pallas=pallas, generation_order=sym, compact=eff_cand,
         )
-        # Append novel rows (compacted to the perm front) at the queue tail.
-        # Rows past ``n_new`` in the written window are garbage; they sit in
-        # [tail+n_new, tail+m) which later appends overwrite before ``tail``
-        # ever reaches them.
-        sel = order[perm]  # compose the two gathers into one
+        # Append novel rows (novel-compacted ``sel`` prefix) at the queue
+        # tail.  Rows past ``n_new`` in the written window are garbage; they
+        # sit in [tail+n_new, tail+eff_cand) which later appends overwrite
+        # before ``tail`` ever reaches them.
         qrows = jax.lax.dynamic_update_slice(qrows, cand_rows[sel], (tail, jnp.int32(0)))
         qfp = jax.lax.dynamic_update_slice(qfp, cand_fp[sel], (tail,))
         qebits = jax.lax.dynamic_update_slice(qebits, cand_ebt[sel], (tail,))
         qdepth = jax.lax.dynamic_update_slice(qdepth, cand_dep[sel], (tail,))
 
-        # A bucket overflow means the insert wrote nothing: leave the cursors
-        # and counters untouched so the batch replays after the host grows
-        # the table.  (The queue append above wrote garbage past ``tail``,
-        # which the replay overwrites.)
+        # Any overflow means the insert wrote nothing (n_new == 0): leave
+        # the cursors and counters untouched so the batch replays after the
+        # host grows the table / candidate budget.  (The queue append above
+        # wrote garbage past ``tail``, which the replay overwrites.)
+        overflow = toverflow | coverflow
         head = jnp.where(overflow, head, head + jnp.minimum(n_avail, batch))
-        tail = jnp.where(overflow, tail, tail + n_new)
-        unique = jnp.where(overflow, unique, unique + n_new.astype(jnp.int64))
+        tail = tail + n_new
+        unique = unique + n_new.astype(jnp.int64)
         scount = jnp.where(
             overflow, scount, scount + jnp.sum(valid, dtype=jnp.int64)
         )
@@ -221,9 +233,13 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # grows buffers and resumes (table target load ≤ 25%: the Poisson
         # bucket-overflow tail stays negligible).
         status = jnp.where(
-            overflow | (unique * 4 > cap) | (m * 4 > cap),
+            toverflow | (unique * 4 > cap) | (eff_cand * 4 > cap),
             jnp.int32(_STATUS_TABLE_FULL),
-            jnp.where(tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status),
+            jnp.where(
+                coverflow,
+                jnp.int32(_STATUS_CAND_FULL),
+                jnp.where(tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status),
+            ),
         )
         return (tfp, tpl, cnt, qrows, qfp, qebits, qdepth, head, tail,
                 unique, scount, disc, maxdepth, status)
@@ -266,12 +282,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
 
         irows = jnp.asarray(init_rows_np)
         ifp = row_hash(tensor.representative_rows(irows) if sym else irows)
-        tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
+        tfp, tpl, cnt, sel, n_new, overflow, _ = bucket_insert(
             tfp, tpl, cnt, ifp,
             jnp.zeros((n_init,), jnp.uint64),  # parent 0 = "is an init state"
             window=n_init, use_pallas=pallas, generation_order=sym,
         )
-        sel = order[perm]
         qrows = jax.lax.dynamic_update_slice(
             qrows, irows[sel], (jnp.int32(0), jnp.int32(0))
         )
@@ -280,7 +295,9 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             qebits, jnp.full((n_init,), init_ebits, jnp.uint32), (jnp.int32(0),)
         )
         status = jnp.where(
-            overflow | (n_new.astype(jnp.int64) * 4 > cap) | (m * 4 > cap),
+            overflow
+            | (n_new.astype(jnp.int64) * 4 > cap)
+            | (eff_cand * 4 > cap),
             jnp.int32(_STATUS_TABLE_FULL),
             jnp.where(
                 n_new > qcap,  # init set alone past the high-water mark
@@ -324,6 +341,10 @@ class TpuChecker(WavefrontChecker):
     ``batch`` — rows expanded per device step (``frontier_capacity`` is the
     backwards-compatible alias).  ``queue_capacity`` — queue high-water mark
     (default: ``capacity // 2``; grown/compacted on demand).
+    ``cand`` — valid-candidate compaction budget per batch (default
+    ``max(4 * batch, 4096)``; doubled on demand): the insert pipeline runs
+    at this width instead of the fully padded ``batch * max_actions``,
+    which is the engine's main latency lever on hardware.
     ``steps_per_call`` — device steps per host round-trip: the host syncs
     this often to refresh live counters and serve checkpoint requests.
     ``resume`` — a snapshot from :meth:`checkpoint` to continue from.
@@ -350,6 +371,7 @@ class TpuChecker(WavefrontChecker):
         sync: bool = False,
         resume: Optional[dict] = None,
         pallas: Optional[bool] = None,
+        cand: Optional[int] = None,
     ):
         import os
 
@@ -360,6 +382,7 @@ class TpuChecker(WavefrontChecker):
         if batch is None:
             batch = frontier_capacity if frontier_capacity else 1 << 11
         self._batch = max(8, batch)
+        self._cand = cand or max(4 * self._batch, 4096)
         self._qcap = queue_capacity or max(self._cap // 2, 4 * self._batch)
         self._steps = steps_per_call
         self._resume = resume
@@ -372,18 +395,19 @@ class TpuChecker(WavefrontChecker):
 
     # -- run loop ------------------------------------------------------------
 
-    def _engine(self, cap, qcap, batch):
+    def _engine(self, cap, qcap, batch, cand):
         cache = getattr(self.tensor, "_run_cache", None)
         if cache is None:
             cache = {}
             self.tensor._run_cache = cache
         sym = self._symmetry is not None
-        key = (cap, qcap, batch, self._steps, self._target, self._pallas, sym)
+        key = (cap, qcap, batch, cand, self._steps, self._target,
+               self._pallas, sym)
         eng = cache.get(key)
         if eng is None:
             eng = _build_engine(
                 self.tensor, self._props, cap, qcap, batch, self._steps,
-                self._target, pallas=self._pallas, sym=sym,
+                self._target, pallas=self._pallas, sym=sym, cand=cand,
             )
             cache[key] = eng
         return eng
@@ -393,6 +417,7 @@ class TpuChecker(WavefrontChecker):
             k: np.asarray(v) for k, v in zip(_SNAPSHOT_KEYS, carry)
         }
         snap["cap"], snap["qcap"], snap["batch"] = cap, qcap, self._batch
+        snap["cand"] = self._cand  # self-tuned budget survives resume
         snap["width"] = self.tensor.width
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
@@ -407,6 +432,7 @@ class TpuChecker(WavefrontChecker):
         cap = int(snap["cap"])
         qcap = int(snap["qcap"])
         self._batch = int(snap.get("batch", self._batch))
+        self._cand = int(snap.get("cand", self._cand))
         qalloc = qcap + self._batch * self.tensor.max_actions
         carry = [np.asarray(snap[k]) for k in _SNAPSHOT_KEYS]
         # snapshots may have been taken at a different qalloc; re-pad
@@ -454,22 +480,26 @@ class TpuChecker(WavefrontChecker):
     def _run(self):
         cap, qcap, batch = self._cap, self._qcap, self._batch
         arity = self.tensor.max_actions
+        cand = min(self._cand, batch * arity)
         # static preconditions are known here; pre-size rather than paying an
-        # engine compile + re-init per doubling: m*4 <= cap, and the init set
-        # must fit the queue (its write window is qalloc = qcap + m)
-        while batch * arity * 4 > cap:
+        # engine compile + re-init per doubling: cand*4 <= cap, and the init
+        # set must fit the queue (its write window is qalloc = qcap + m)
+        while cand * 4 > cap:
             cap *= 2
         n_init = len(np.asarray(self.tensor.init_rows()))
         while n_init > qcap:
             qcap *= 2
-        self._cap, self._qcap = cap, qcap
+        self._cap, self._qcap, self._cand = cap, qcap, cand
         if self._resume is not None:
             cap, qcap, carry = self._snapshot_to_carry(self._resume)
             batch = self._batch  # the snapshot's batch governs buffer layout
+            cand = min(self._cand, batch * arity)  # snapshot's tuned budget
             stats = None
             # a snapshot taken at a growth boundary still carries the flag
             st = int(np.asarray(carry[_STATUS]))
             if st != _STATUS_OK:
+                if st == _STATUS_CAND_FULL:
+                    cand = min(cand * 2, batch * arity)
                 carry_np = [np.asarray(c) for c in carry]
                 cap, qcap, carry_np = self._grow(
                     carry_np, cap, qcap, batch, arity, st
@@ -477,7 +507,7 @@ class TpuChecker(WavefrontChecker):
                 carry = [jnp.asarray(c) for c in carry_np]
         else:
             while True:
-                init_fn, _ = self._engine(cap, qcap, batch)
+                init_fn, _ = self._engine(cap, qcap, batch, cand)
                 carry, stats = init_fn()
                 carry = list(carry)
                 stats = np.asarray(stats)
@@ -490,7 +520,7 @@ class TpuChecker(WavefrontChecker):
                     break
                 n_init = len(self.model.init_states())
                 prev = cap
-                while (n_init * 4 > cap) or (batch * arity * 4 > cap):
+                while (n_init * 4 > cap) or (cand * 4 > cap):
                     cap *= 2
                 if cap == prev:
                     cap *= 2  # guarantee progress on a clustered init set
@@ -518,6 +548,21 @@ class TpuChecker(WavefrontChecker):
                 self._ckpt_ready.set()
             if status != _STATUS_OK:
                 self.growth_events.append((status, unique))
+                if status == _STATUS_CAND_FULL:
+                    # the candidate budget is an engine parameter, not a
+                    # carry buffer: double it, clear the carry's status word
+                    # (the insert wrote nothing, so the carry is otherwise
+                    # consistent), rebuild, replay
+                    cand = min(cand * 2, batch * arity)
+                    carry[_STATUS] = jnp.int32(_STATUS_OK)
+                    while cand * 4 > cap:
+                        cap, qcap, carry_np = self._grow(
+                            [np.asarray(c) for c in carry], cap, qcap,
+                            batch, arity, _STATUS_TABLE_FULL,
+                        )
+                        carry = [jnp.asarray(c) for c in carry_np]
+                    stats = None
+                    continue
                 carry_np = [np.asarray(c) for c in carry]
                 cap, qcap, carry_np = self._grow(
                     carry_np, cap, qcap, batch, arity, status
@@ -534,12 +579,12 @@ class TpuChecker(WavefrontChecker):
                 done = True
             if done:
                 break
-            _, run_fn = self._engine(cap, qcap, batch)
+            _, run_fn = self._engine(cap, qcap, batch, cand)
             carry, stats = run_fn(tuple(carry))
             carry = list(carry)
             stats = np.asarray(stats)
 
-        self._cap, self._qcap = cap, qcap
+        self._cap, self._qcap, self._cand = cap, qcap, cand
         # Keep final buffers on device; pulling the table/queue through the
         # tunnel costs far more than the run's last batches, so snapshots and
         # parent maps materialize lazily on demand.

@@ -27,20 +27,23 @@ def fresh(nbuckets):
     )
 
 
-def insert(state, fps, payloads=None, window=8):
+def insert(state, fps, payloads=None, window=8, compact=None):
     tfp, tpl, cnt = state
     fps = jnp.asarray(np_u64(fps))
     if payloads is None:
         payloads = fps ^ jnp.uint64(7)
     else:
         payloads = jnp.asarray(np_u64(payloads))
-    tfp, tpl, cnt, order, perm, novel, n_new, overflow = bucket_insert(
-        tfp, tpl, cnt, fps, payloads, window=window
+    tfp, tpl, cnt, sel, n_new, overflow, cand_overflow = bucket_insert(
+        tfp, tpl, cnt, fps, payloads, window=window, compact=compact
     )
-    inserted = np.asarray(fps)[np.asarray(order)[np.asarray(perm)]][
-        : int(n_new)
-    ]
-    return (tfp, tpl, cnt), inserted, int(n_new), bool(overflow)
+    inserted = np.asarray(fps)[np.asarray(sel)][: int(n_new)]
+    return (
+        (tfp, tpl, cnt),
+        inserted,
+        int(n_new),
+        bool(overflow) or bool(cand_overflow),
+    )
 
 
 def table_contents(state):
@@ -109,6 +112,73 @@ def test_window_chunking_covers_large_batches():
     state, inserted, n_new, overflow = insert(state, fps, window=32)
     assert not overflow and n_new == 400
     assert sorted(table_contents(state)) == sorted(fps.tolist())
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_compacted_stream_matches_host_set(seed):
+    """``compact=CB`` (the engines' padded-batch fast path) must agree with
+    the host set exactly, including EMPTY-heavy lanes, in-batch duplicates,
+    and duplicates vs the table."""
+    rng = np.random.default_rng(seed)
+    state = fresh(64)
+    seen = set()
+    for _ in range(12):
+        m = int(rng.integers(8, 80))
+        fps = rng.integers(1, 1 << 40, m).astype(np.uint64)
+        fps[rng.random(m) < 0.7] = EMPTY  # mostly padding, like a batch
+        if m > 3:
+            fps[0] = fps[m // 2]
+        state, inserted, n_new, overflow = insert(
+            state, fps, window=8, compact=32
+        )
+        assert not overflow
+        expected = [
+            f for i, f in enumerate(fps.tolist())
+            if f != int(EMPTY) and f not in seen
+            and f not in set(fps[:i].tolist())
+        ]
+        assert n_new == len(expected)
+        assert sorted(inserted.tolist()) == sorted(expected)
+        seen.update(expected)
+    assert sorted(table_contents(state)) == sorted(seen)
+
+
+def test_cand_overflow_writes_nothing():
+    """More valid candidates than the compaction budget: atomically refuse
+    (nothing written, n_new 0) so the caller can grow + replay."""
+    state = fresh(1 << 6)
+    fps = np.arange(1, 41, dtype=np.uint64) * 97  # 40 valid > compact=16
+    state, inserted, n_new, overflow = insert(
+        state, fps, window=8, compact=16
+    )
+    assert overflow and n_new == 0 and len(inserted) == 0
+    assert table_contents(state) == {}
+    assert int(np.asarray(state[2]).sum()) == 0
+    # and the same stream succeeds once the budget covers it
+    state, _, n_new, overflow = insert(state, fps, window=8, compact=64)
+    assert not overflow and n_new == 40
+
+
+def test_compacted_generation_order_is_preserved():
+    """generation_order=True with compaction: sel[:n_new] lists inserted
+    candidates by ORIGINAL batch position (symmetry runs key on it)."""
+    state = fresh(64)
+    fps = np.array(
+        [int(EMPTY), 901, int(EMPTY), 17, 445, int(EMPTY), 23], np.uint64
+    )
+    tfp, tpl, cnt = state
+    tfp, tpl, cnt, sel, n_new, ofl, cofl = bucket_insert(
+        tfp,
+        tpl,
+        cnt,
+        jnp.asarray(fps),
+        jnp.asarray(fps),
+        window=4,
+        generation_order=True,
+        compact=4,
+    )
+    assert not bool(ofl) and not bool(cofl) and int(n_new) == 4
+    assert np.asarray(sel)[:4].tolist() == [1, 3, 4, 6]
 
 
 def test_host_rehash_round_trip():

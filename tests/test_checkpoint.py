@@ -93,7 +93,9 @@ def test_growth_boundary_checkpoint_resume():
     snap = running.checkpoint(timeout=120.0)
     running.stop().join()
     assert 0 < int(snap["unique"]) < 8832, "checkpoint was not mid-run"
-    for status in (2, 1):  # _STATUS_TABLE_FULL (rehash), _STATUS_QUEUE_FULL
+    # _STATUS_TABLE_FULL (rehash), _STATUS_QUEUE_FULL (compact),
+    # _STATUS_CAND_FULL (budget doubles, no carry transform)
+    for status in (2, 1, 3):
         s = dict(snap)
         s["status"] = np.int32(status)
         resumed = TwoPhaseSys(5).checker().spawn_tpu(sync=True, resume=s)
@@ -114,6 +116,19 @@ def test_table_growth_preserves_work():
     checker = run_full(5, capacity=1 << 8, batch=32)
     assert checker.unique_state_count() == 8832
     assert checker._cap > (1 << 8)
+    checker.assert_properties()
+
+
+def test_cand_budget_growth_preserves_work():
+    """A candidate budget far below the batch's real fanout forces
+    _STATUS_CAND_FULL growth events mid-run; the budget doubles (engine
+    parameter only — the replayed carry is untouched) and the run still
+    finishes with pinned counts.  Regression: the growth branch previously
+    never cleared the carry's status word and looped forever."""
+    checker = run_full(3, batch=32, cand=16, capacity=1 << 12)
+    assert checker.unique_state_count() == 288  # examples/2pc.rs:128
+    assert any(status == 3 for status, _ in checker.growth_events)
+    assert checker._cand > 16
     checker.assert_properties()
 
 

@@ -42,12 +42,17 @@ def test_pallas_matches_xla_insert(m, nbuckets):
         rp = bucket_insert(
             tfp_p, tpl_p, cnt_p, fps, payloads, window=64, use_pallas=True
         )
+        # (tfp, tpl, cnt, sel, n_new, overflow, cand_overflow)
         tfp_x, tpl_x, cnt_x = rx[0], rx[1], rx[2]
         tfp_p, tpl_p, cnt_p = rp[0], rp[1], rp[2]
-        assert bool(rx[7]) == bool(rp[7]), round_  # overflow agreement
-        if bool(rx[7]):
+        assert bool(rx[5]) == bool(rp[5]), round_  # overflow agreement
+        if bool(rx[5]):
             break
-        np.testing.assert_array_equal(np.asarray(rx[5]), np.asarray(rp[5]))
+        assert int(rx[4]) == int(rp[4])  # n_new agreement
+        # inserted-candidate selection agreement (novelty verdicts)
+        np.testing.assert_array_equal(
+            np.asarray(rx[3])[: int(rx[4])], np.asarray(rp[3])[: int(rp[4])]
+        )
         np.testing.assert_array_equal(np.asarray(tfp_x), np.asarray(tfp_p))
         np.testing.assert_array_equal(np.asarray(tpl_x), np.asarray(tpl_p))
         np.testing.assert_array_equal(np.asarray(cnt_x), np.asarray(cnt_p))
@@ -64,7 +69,7 @@ def test_pallas_overflow_writes_nothing():
     )
     payloads = jnp.arange(SLOTS + 1, dtype=jnp.uint64)
     out = bucket_insert(tfp, tpl, cnt, fps, payloads, window=8, use_pallas=True)
-    assert bool(out[7])
+    assert bool(out[5]) and int(out[4]) == 0  # overflow, nothing counted
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(tfp))
     np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(cnt))
 

@@ -194,3 +194,21 @@ def test_paxos6_device_engine_prefix():
     tail = int(np.asarray(c._final_carry[wf._TAIL]))
     for r in rows[:tail:37]:  # stride-sample the queue
         assert tm.pk.unpack(r[: tm.pw])["overflow"] == 0
+
+
+@pytest.mark.slow
+def test_paxos3_full_space_device_vs_cpu():
+    """THE flagship parity result: the COMPLETE paxos-3 space — 1,194,428
+    unique states, the driver benchmark's primary config run to exhaustion
+    — enumerated by both the CPU oracle and the device engine with equal
+    counts and discoveries.  (The bench pins the device side of this number
+    every run; this test pins it against the object-form oracle.)"""
+    m = paxos_model(3, 3)
+    tpu = m.checker().spawn_tpu(
+        sync=True, capacity=1 << 23, queue_capacity=1 << 21, batch=2048
+    )
+    assert tpu.unique_state_count() == 1_194_428
+    cpu = m.checker().spawn_bfs().join()
+    assert cpu.unique_state_count() == 1_194_428
+    assert cpu.state_count() == tpu.state_count()
+    assert set(cpu.discoveries()) == set(tpu.discoveries()) == {"value chosen"}
