@@ -16,10 +16,13 @@ effectively index-serial, so the fix is architectural, not incremental:
    equal fingerprints adjacently for first-occurrence dedup and (b) groups
    same-bucket candidates adjacently so per-bucket insertion ranks are a
    cumulative-sum away.
- - Every novel candidate's slot is ``count[bucket] + rank`` — computed
-   vectorially, written with a *windowed chunked* scatter that touches only
-   ~``n_new`` entries instead of all ``M`` candidates (scatter cost scales
-   with indices, so writing only what's new is the big win).
+ - Every novel candidate's slot is ``occupancy(bucket) + rank`` — slots fill
+   densely and never free, so a bucket's occupancy is just the non-EMPTY
+   count of its (already gathered) line: no separate counts array exists,
+   and no occupancy update is ever written.  Ranks are computed vectorially
+   and the fp/payload writes go through a *windowed chunked* scatter that
+   touches only ~``n_new`` entries instead of all ``M`` candidates (scatter
+   cost scales with indices, so writing only what's new is the big win).
  - A bucket overflowing its ``SLOTS`` raises an overflow flag; the caller
    grows the table and rehashes host-side.  At the engine's ≤25% load factor
    the Poisson tail P(bucket > 16 | λ=4) ≈ 1e-7 makes that a rare event.
@@ -51,7 +54,6 @@ def rotate_key(fps: jnp.ndarray, bucket_bits: int) -> jnp.ndarray:
 def bucket_insert(
     table_fp: jnp.ndarray,  # uint64[nbuckets * SLOTS]; EMPTY = free
     table_payload: jnp.ndarray,  # uint64[nbuckets * SLOTS]
-    counts: jnp.ndarray,  # uint32[nbuckets] occupancy
     fps: jnp.ndarray,  # uint64[M] candidates (EMPTY = invalid lane)
     payloads: jnp.ndarray,  # uint64[M]
     window: int,  # scatter chunk size (≈ expected novel per batch)
@@ -63,7 +65,7 @@ def bucket_insert(
     #                       lanes first and run the pipeline at width CB
 ):
     """Insert all valid candidates; returns ``(table_fp, table_payload,
-    counts, sel, n_new, overflow, cand_overflow)``.
+    sel, n_new, overflow, cand_overflow)``.
 
     ``sel[:n_new]`` holds the ORIGINAL indices (into ``fps``) of the
     inserted candidates — table order for plain runs, generation order
@@ -72,7 +74,7 @@ def bucket_insert(
     whatever they gather with them).  On ``overflow`` (a bucket clustered
     past SLOTS) or ``cand_overflow`` (more valid candidates than the
     ``compact`` budget) NOTHING was written, ``n_new`` is 0, and the
-    table/counts return unchanged — the caller grows the table / its
+    table returns unchanged — the caller grows the table / its
     candidate budget and replays the batch, so no work is lost.
 
     ``compact=CB`` first compacts the valid lanes into a CB-wide buffer
@@ -139,8 +141,11 @@ def bucket_insert(
         off = k * window
         wbkt = jax.lax.dynamic_slice(pbucket, (off,), (window,))
         wfp = jax.lax.dynamic_slice(psfp, (off,), (window,))
-        p = jnp.any(table_lines[wbkt] == wfp[:, None], axis=-1)
-        b = counts[wbkt].astype(jnp.int32)
+        lines = table_lines[wbkt]
+        p = jnp.any(lines == wfp[:, None], axis=-1)
+        # occupancy comes free from the same gathered line: slots fill
+        # densely from 0 and never free, so non-EMPTY count == next slot
+        b = jnp.sum(lines != EMPTY, axis=-1).astype(jnp.int32)
         present = jax.lax.dynamic_update_slice(present, p, (off,))
         base = jax.lax.dynamic_update_slice(base, b, (off,))
         return k + 1, present, base
@@ -234,56 +239,24 @@ def bucket_insert(
             chunk_cond, chunk_body, (jnp.int32(0), table_fp, table_payload)
         )
 
-    # occupancy update: scatter final count from each bucket's last novel row
-    new_count = (slot + 1).astype(jnp.uint32)
-    is_last_writer = novel & ~_has_later_novel(novel, bucket)
-    cnt_tgt = padded(jnp.where(is_last_writer, bucket, nbuckets)[perm], nbuckets)
-    cnt_val = padded(new_count[perm], 0)
-
-    def cnt_body(state):
-        k, counts = state
-        off = k * window
-        t = jax.lax.dynamic_slice(cnt_tgt, (off,), (window,))
-        v = jax.lax.dynamic_slice(cnt_val, (off,), (window,))
-        in_range = jnp.arange(window, dtype=jnp.int32) + off < n_new
-        t = jnp.where(in_range, t, nbuckets)
-        return k + 1, counts.at[t].set(v, mode="drop")
-
-    _, counts = jax.lax.while_loop(
-        chunk_cond, lambda s: cnt_body(s), (jnp.int32(0), counts)
-    )
     sel = order[perm]
     if cidx is not None:
         sel = cidx[sel]  # map compacted positions back to original indices
-    return table_fp, table_payload, counts, sel, n_new, overflow, cand_overflow
-
-
-def _has_later_novel(novel: jnp.ndarray, bucket: jnp.ndarray) -> jnp.ndarray:
-    """True for rows with a later novel row in the same bucket (rows are
-    bucket-sorted).  Reverse-cumulative trick: walking from the end, track
-    the bucket of the most recent novel row seen."""
-    sentinel = jnp.int32(-1)
-    rev_b = jnp.where(novel, bucket, sentinel)[::-1]
-    # last-seen novel bucket *before* each position in reverse order
-    seen = jax.lax.associative_scan(
-        lambda a, b: jnp.where(b == sentinel, a, b), rev_b
-    )
-    prev_seen = jnp.concatenate([jnp.full((1,), sentinel), seen[:-1]])[::-1]
-    return prev_seen == bucket
+    return table_fp, table_payload, sel, n_new, overflow, cand_overflow
 
 
 def host_bucket_rehash(
     table_fp: np.ndarray, table_payload: np.ndarray, new_nbuckets: int
 ):
     """Rebuild the bucketized table with ``new_nbuckets`` buckets (numpy).
-    Returns ``(table_fp, table_payload, counts)``."""
+    Returns ``(table_fp, table_payload)``: slots fill densely per bucket,
+    so occupancy is implicit in the table itself."""
     assert new_nbuckets & (new_nbuckets - 1) == 0
     occ = table_fp != EMPTY
     f = table_fp[occ]
     p = table_payload[occ]
     out_fp = np.full(new_nbuckets * SLOTS, EMPTY, np.uint64)
     out_pl = np.zeros(new_nbuckets * SLOTS, np.uint64)
-    counts = np.zeros(new_nbuckets, np.uint32)
     bucket = (f & np.uint64(new_nbuckets - 1)).astype(np.int64)
     order = np.argsort(bucket, kind="stable")
     bucket, f, p = bucket[order], f[order], p[order]
@@ -293,5 +266,4 @@ def host_bucket_rehash(
         raise ValueError("bucket overflow during rehash; grow further")
     out_fp[bucket * SLOTS + rank] = f
     out_pl[bucket * SLOTS + rank] = p
-    np.add.at(counts, bucket, 1)
-    return out_fp, out_pl, counts
+    return out_fp, out_pl

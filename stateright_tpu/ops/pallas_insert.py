@@ -31,11 +31,10 @@ This kernel instead walks the novel candidates once, streaming each touched
 u64 tables and candidate words to pairs of u32 lanes (little-endian: lane
 ``2k`` = low word of slot ``k``).
 
-Bucket occupancy counts stay on the XLA windowed-scatter path in
-``bucket_insert``: exactly one row per bucket (the max-rank novel row)
-carries a real count target, so that scatter is write-order-independent and
-tiny, while the u64 fp/payload writes — the HBM-bandwidth cost — go through
-this kernel.
+No occupancy metadata exists to maintain: slots fill densely and never
+free, so a bucket's occupancy is implicit in its line (``ops/buckets.py``
+derives it from the membership gather) — the u64 fp/payload writes this
+kernel performs are the whole visited-set update.
 
 Correctness contract (same as the XLA scatters): target slots are distinct
 (bucket * SLOTS + per-bucket rank) and candidates are pre-deduplicated and

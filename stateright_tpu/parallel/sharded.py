@@ -193,7 +193,7 @@ def _build_sharded_run(
 
     # -- owner-side dedup + insert + compaction ------------------------------
 
-    def insert_and_compact(tfp, tpl, cnt, cand_rows, cand_fp, cand_par,
+    def insert_and_compact(tfp, tpl, cand_rows, cand_fp, cand_par,
                            cand_ebits, compact=None):
         """Dedup candidates, claim table slots (bucketized one-shot insert —
         same visited-set as the single-device engine, ``ops/buckets.py``;
@@ -203,8 +203,8 @@ def _build_sharded_run(
         is the valid-candidate budget (see ``bucket_insert``) — the insert
         pipeline runs at that width instead of the padded receive size."""
         m = cand_fp.shape[0]
-        tfp, tpl, cnt, sel, n_new, toverflow, coverflow = bucket_insert(
-            tfp, tpl, cnt, cand_fp, cand_par,
+        tfp, tpl, sel, n_new, toverflow, coverflow = bucket_insert(
+            tfp, tpl, cand_fp, cand_par,
             window=min(m, max(64, fcap_local)), generation_order=sym,
             compact=compact,
         )
@@ -219,7 +219,7 @@ def _build_sharded_run(
             nrows = jnp.concatenate([nrows, jnp.zeros((pad, width), jnp.uint64)])
             nfps = jnp.concatenate([nfps, jnp.full((pad,), EMPTY, jnp.uint64)])
             nebt = jnp.concatenate([nebt, jnp.zeros((pad,), jnp.uint32)])
-        return tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow, coverflow
+        return tfp, tpl, nrows, nfps, nebt, n_new, toverflow, coverflow
 
     # -- the per-device program ----------------------------------------------
 
@@ -228,7 +228,6 @@ def _build_sharded_run(
 
         tfp = _to_varying(jnp.full((cap_local,), EMPTY, jnp.uint64))
         tpl = _to_varying(jnp.zeros((cap_local,), jnp.uint64))
-        cnt = _to_varying(jnp.zeros((cap_local // SLOTS,), jnp.uint32))
 
         # Each device claims the init states it owns (no routing needed: the
         # init set is a replicated constant).
@@ -238,9 +237,8 @@ def _build_sharded_run(
         cand_fp = jnp.where(mine, ifp, EMPTY)
         cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = init state
         cand_ebt = jnp.full((n_init,), init_ebits, jnp.uint32)
-        tfp, tpl, cnt, rows0, fps0, ebt0, n_new, toverflow, _ = (
-            insert_and_compact(tfp, tpl, cnt, irows, cand_fp, cand_par,
-                               cand_ebt)
+        tfp, tpl, rows0, fps0, ebt0, n_new, toverflow, _ = (
+            insert_and_compact(tfp, tpl, irows, cand_fp, cand_par, cand_ebt)
         )
         unique = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
         foverflow = n_new > fcap_local
@@ -253,14 +251,14 @@ def _build_sharded_run(
                 jnp.int32(_OK),
             ),
         )
-        carry = (tfp, tpl, cnt, rows0, fps0, ebt0, unique,
+        carry = (tfp, tpl, rows0, fps0, ebt0, unique,
                  jnp.int64(n_init),  # state_count counts all inits
                  jnp.zeros((max(n_props, 1),), jnp.uint64),
                  jnp.int32(0), status)
         return carry + (keep_going(carry).astype(jnp.int32),)
 
     def keep_going(carry):
-        fps, unique, disc, status = carry[4], carry[6], carry[8], carry[10]
+        fps, unique, disc, status = carry[3], carry[5], carry[7], carry[9]
         frontier_live = (
             jax.lax.pmax(jnp.any(fps != EMPTY).astype(jnp.int32), AXIS) > 0
         )
@@ -276,7 +274,7 @@ def _build_sharded_run(
         (status aside) so the host can grow buffers and replay it."""
 
         def expand(carry):
-            (tfp, tpl, cnt, rows, fps, ebits, unique, scount, disc, depth,
+            (tfp, tpl, rows, fps, ebits, unique, scount, disc, depth,
              status) = carry
             live = fps != EMPTY
             ebits, disc = eval_props(rows, fps, live, ebits, disc)
@@ -302,18 +300,20 @@ def _build_sharded_run(
             rfp, rrows, rpar, rebt, boverflow = route(
                 cand_fp, cand_rows, cand_par, cand_ebt
             )
-            tfp, tpl, cnt, nrows, nfps, nebt, n_new, toverflow, coverflow = (
-                insert_and_compact(tfp, tpl, cnt, rrows, rfp, rpar, rebt,
+            tfp, tpl, nrows, nfps, nebt, n_new, toverflow, coverflow = (
+                insert_and_compact(tfp, tpl, rrows, rfp, rpar, rebt,
                                    compact=cand_local)
             )
             n_new_g = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
             unique = unique + n_new_g
             foverflow = jax.lax.pmax(n_new > fcap_local, AXIS)
             coverflow = jax.lax.pmax(coverflow, AXIS)
-            # proactive growth at 25% shard load: past it the Poisson bucket
-            # overflow tail stops being negligible (cf. wavefront.py)
-            used = jnp.sum(cnt.astype(jnp.int64))
-            tthresh = used * jnp.int64(4) > jnp.int64(cap_local)
+            # proactive growth at 25% GLOBAL load: past it the Poisson bucket
+            # overflow tail stops being negligible (cf. wavefront.py).  The
+            # global unique counter is already replicated, so this is O(1);
+            # per-shard skew beyond it is backstopped by the atomic bucket
+            # overflow path (fingerprint uniformity keeps shards balanced).
+            tthresh = unique * jnp.int64(4) > jnp.int64(ndev * cap_local)
             toverflow = jax.lax.pmax(toverflow | tthresh, AXIS)
             status = jnp.where(
                 toverflow,
@@ -333,12 +333,12 @@ def _build_sharded_run(
                 ),
             )
             depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
-            return (tfp, tpl, cnt, nrows, nfps, nebt, unique, scount, disc,
+            return (tfp, tpl, nrows, nfps, nebt, unique, scount, disc,
                     depth, status)
 
         def body(carry):
             new = expand(carry)
-            status = new[10]
+            status = new[9]
             # Atomic step: on overflow nothing advances except the status
             # code, so the host's growth transform resumes from a consistent
             # carry and the failed wavefront replays losslessly.  (The
@@ -346,14 +346,14 @@ def _build_sharded_run(
             # ``bucket_insert`` writing nothing on overflow.)
             ofl = status != jnp.int32(_OK)
             rolled = tuple(
-                jnp.where(ofl, old, nxt) for old, nxt in zip(carry[:10], new[:10])
+                jnp.where(ofl, old, nxt) for old, nxt in zip(carry[:9], new[:9])
             )
             return rolled + (status,)
 
         # Device-local carry components must enter the loop as "varying" over
         # the mesh axis even when their initial value is a replicated constant
         # (shard_map's vma typing for while_loop).
-        carry = tuple(_to_varying(x) for x in carry[:6]) + tuple(carry[6:])
+        carry = tuple(_to_varying(x) for x in carry[:5]) + tuple(carry[5:])
         _, carry = jax.lax.while_loop(
             lambda s: (s[0] < steps) & keep_going(s[1]),
             lambda s: (s[0] + 1, body(s[1])),
@@ -361,7 +361,7 @@ def _build_sharded_run(
         )
         return carry + (keep_going(carry).astype(jnp.int32),)
 
-    in_specs = (P(AXIS),) * 6 + (P(),) * 5
+    in_specs = (P(AXIS),) * 5 + (P(),) * 5
     out_specs = in_specs + (P(),)
     init_fn = jax.jit(
         shard_map(device_init, mesh, in_specs=(), out_specs=out_specs)
@@ -370,7 +370,7 @@ def _build_sharded_run(
         shard_map(
             device_steps, mesh, in_specs=in_specs, out_specs=out_specs
         ),
-        donate_argnums=tuple(range(11)),
+        donate_argnums=tuple(range(10)),
     )
     return init_fn, step_fn
 
@@ -384,7 +384,7 @@ def default_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 _SHARDED_SNAPSHOT_KEYS = (
-    "table_fp", "table_parent", "counts", "rows", "fps", "ebits",
+    "table_fp", "table_parent", "rows", "fps", "ebits",
     "unique", "scount", "disc", "depth", "status",
 )
 
@@ -510,22 +510,21 @@ class ShardedTpuChecker(WavefrontChecker):
             ]
             carry_np[0] = np.concatenate([p[0] for p in parts])
             carry_np[1] = np.concatenate([p[1] for p in parts])
-            carry_np[2] = np.concatenate([p[2] for p in parts])
             cap = cap2
         elif status == _FRONTIER_OVERFLOW:
             fcap2 = fcap * 2
-            width = np.asarray(carry_np[3]).shape[-1]
-            rows = np.asarray(carry_np[3]).reshape(ndev, fcap, width)
-            fps = np.asarray(carry_np[4]).reshape(ndev, fcap)
-            ebt = np.asarray(carry_np[5]).reshape(ndev, fcap)
+            width = np.asarray(carry_np[2]).shape[-1]
+            rows = np.asarray(carry_np[2]).reshape(ndev, fcap, width)
+            fps = np.asarray(carry_np[3]).reshape(ndev, fcap)
+            ebt = np.asarray(carry_np[4]).reshape(ndev, fcap)
             grow = fcap2 - fcap
-            carry_np[3] = np.concatenate(
+            carry_np[2] = np.concatenate(
                 [rows, np.zeros((ndev, grow, width), np.uint64)], axis=1
             ).reshape(ndev * fcap2, width)
-            carry_np[4] = np.concatenate(
+            carry_np[3] = np.concatenate(
                 [fps, np.full((ndev, grow), EMPTY, np.uint64)], axis=1
             ).reshape(-1)
-            carry_np[5] = np.concatenate(
+            carry_np[4] = np.concatenate(
                 [ebt, np.zeros((ndev, grow), np.uint32)], axis=1
             ).reshape(-1)
             fcap = fcap2
@@ -533,7 +532,7 @@ class ShardedTpuChecker(WavefrontChecker):
             bf *= 2
         elif status == _CAND_OVERFLOW:
             cf *= 2
-        carry_np[10] = np.int32(_OK)
+        carry_np[9] = np.int32(_OK)
         return cap, fcap, bf, cf, carry_np
 
     def _run(self):
@@ -558,7 +557,7 @@ class ShardedTpuChecker(WavefrontChecker):
         if self._resume is not None:
             carry0 = [np.asarray(self._resume[k])
                       for k in _SHARDED_SNAPSHOT_KEYS]
-            st = int(carry0[10])
+            st = int(carry0[9])
             if st != _OK:
                 # snapshot taken at a growth boundary: grow first, then run
                 cap, fcap, bf, cf, carry0 = self._grow_carry(
@@ -598,9 +597,9 @@ class ShardedTpuChecker(WavefrontChecker):
                 # only the replicated scalars cross to the host per sync
                 # (one batched transfer); the sharded carry stays
                 # device-resident between calls
-                carry = out[:11]
+                carry = out[:10]
                 unique, scount, depth, status, more, disc = jax.device_get(
-                    (out[6], out[7], out[9], out[10], out[11], out[8])
+                    (out[5], out[6], out[8], out[9], out[10], out[7])
                 )
                 unique, scount, depth, status, more = (
                     int(unique), int(scount), int(depth), int(status),
@@ -647,7 +646,7 @@ class ShardedTpuChecker(WavefrontChecker):
         self._results = {
             "unique": unique,
             "states": scount,
-            "disc": np.asarray(carry[8]),
+            "disc": np.asarray(carry[7]),
             "depth": depth,
             "table_fp": np.asarray(carry[0]),
             "table_parent": np.asarray(carry[1]),

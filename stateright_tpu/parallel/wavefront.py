@@ -77,13 +77,15 @@ _STATUS_TABLE_FULL = 2
 _STATUS_CAND_FULL = 3  # valid candidates exceeded the compaction budget
 
 # Carry tuple indices (shared by the jitted program and the host loop).
-_TFP, _TPL, _CNT, _QROWS, _QFP, _QEBITS, _QDEPTH = 0, 1, 2, 3, 4, 5, 6
+# No occupancy-counts buffer exists: bucket occupancy is implicit in the
+# table (slots fill densely; see ops/buckets.py).
+_TFP, _TPL, _QROWS, _QFP, _QEBITS, _QDEPTH = 0, 1, 2, 3, 4, 5
 _HEAD, _TAIL, _UNIQUE, _SCOUNT, _DISC, _MAXDEPTH, _STATUS = (
-    7, 8, 9, 10, 11, 12, 13,
+    6, 7, 8, 9, 10, 11, 12,
 )
 
 _SNAPSHOT_KEYS = (
-    "table_fp", "table_parent", "counts", "q_rows", "q_fp", "q_ebits",
+    "table_fp", "table_parent", "q_rows", "q_fp", "q_ebits",
     "q_depth", "head", "tail", "unique", "scount", "disc", "maxdepth",
     "status",
 )
@@ -167,7 +169,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
 
     def step(carry):
         """Pop one batch, expand, dedup+insert, append novel rows."""
-        (tfp, tpl, cnt, qrows, qfp, qebits, qdepth, head, tail,
+        (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
          unique, scount, disc, maxdepth, status) = carry
         n_avail = tail - head
         rows = jax.lax.dynamic_slice(qrows, (head, jnp.int32(0)), (batch, width))
@@ -205,8 +207,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # window stays at ``batch`` (measured: one cand-wide loop iteration
         # is SLOWER than 2-3 batch-wide ones — wide iterations pay for dead
         # lanes; the compaction budget only bounds the pipeline width)
-        tfp, tpl, cnt, sel, n_new, toverflow, coverflow = bucket_insert(
-            tfp, tpl, cnt, cand_fp, cand_par, window=batch,
+        tfp, tpl, sel, n_new, toverflow, coverflow = bucket_insert(
+            tfp, tpl, cand_fp, cand_par, window=batch,
             use_pallas=pallas, generation_order=sym, compact=eff_cand,
         )
         # Append novel rows (novel-compacted ``sel`` prefix) at the queue
@@ -241,7 +243,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                 jnp.where(tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status),
             ),
         )
-        return (tfp, tpl, cnt, qrows, qfp, qebits, qdepth, head, tail,
+        return (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
                 unique, scount, disc, maxdepth, status)
 
     def cond(state):
@@ -274,7 +276,6 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     def init_fn():
         tfp = jnp.full((cap,), EMPTY, jnp.uint64)
         tpl = jnp.zeros((cap,), jnp.uint64)
-        cnt = jnp.zeros((cap // SLOTS,), jnp.uint32)
         qrows = jnp.zeros((qalloc, width), jnp.uint64)
         qfp = jnp.full((qalloc,), EMPTY, jnp.uint64)
         qebits = jnp.zeros((qalloc,), jnp.uint32)
@@ -282,8 +283,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
 
         irows = jnp.asarray(init_rows_np)
         ifp = row_hash(tensor.representative_rows(irows) if sym else irows)
-        tfp, tpl, cnt, sel, n_new, overflow, _ = bucket_insert(
-            tfp, tpl, cnt, ifp,
+        tfp, tpl, sel, n_new, overflow, _ = bucket_insert(
+            tfp, tpl, ifp,
             jnp.zeros((n_init,), jnp.uint64),  # parent 0 = "is an init state"
             window=n_init, use_pallas=pallas, generation_order=sym,
         )
@@ -305,7 +306,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                 jnp.int32(_STATUS_OK),
             ),
         )
-        carry = (tfp, tpl, cnt, qrows, qfp, qebits, qdepth,
+        carry = (tfp, tpl, qrows, qfp, qebits, qdepth,
                  jnp.int32(0), n_new,
                  n_new.astype(jnp.int64),
                  jnp.int64(n_init),  # state_count counts all inits (bfs parity)
@@ -460,10 +461,10 @@ class TpuChecker(WavefrontChecker):
                     cap *= 2
             elif status == _STATUS_TABLE_FULL:
                 cap *= 2  # a single bucket clustered past SLOTS entries
-            tfp, tpl, cnt = host_bucket_rehash(
+            tfp, tpl = host_bucket_rehash(
                 carry_np[_TFP], carry_np[_TPL], cap // SLOTS
             )
-            carry_np[_TFP], carry_np[_TPL], carry_np[_CNT] = tfp, tpl, cnt
+            carry_np[_TFP], carry_np[_TPL] = tfp, tpl
         head, tail = int(carry_np[_HEAD]), int(carry_np[_TAIL])
         pending = tail - head
         # reclaim the consumed prefix; grow only if still needed

@@ -23,23 +23,22 @@ def fresh(nbuckets):
     return (
         jnp.full((nbuckets * SLOTS,), EMPTY, jnp.uint64),
         jnp.zeros((nbuckets * SLOTS,), jnp.uint64),
-        jnp.zeros((nbuckets,), jnp.uint32),
     )
 
 
 def insert(state, fps, payloads=None, window=8, compact=None):
-    tfp, tpl, cnt = state
+    tfp, tpl = state
     fps = jnp.asarray(np_u64(fps))
     if payloads is None:
         payloads = fps ^ jnp.uint64(7)
     else:
         payloads = jnp.asarray(np_u64(payloads))
-    tfp, tpl, cnt, sel, n_new, overflow, cand_overflow = bucket_insert(
-        tfp, tpl, cnt, fps, payloads, window=window, compact=compact
+    tfp, tpl, sel, n_new, overflow, cand_overflow = bucket_insert(
+        tfp, tpl, fps, payloads, window=window, compact=compact
     )
     inserted = np.asarray(fps)[np.asarray(sel)][: int(n_new)]
     return (
-        (tfp, tpl, cnt),
+        (tfp, tpl),
         inserted,
         int(n_new),
         bool(overflow) or bool(cand_overflow),
@@ -47,7 +46,7 @@ def insert(state, fps, payloads=None, window=8, compact=None):
 
 
 def table_contents(state):
-    tfp, tpl, _ = state
+    tfp, tpl = state
     tfp, tpl = np.asarray(tfp), np.asarray(tpl)
     occ = tfp != EMPTY
     return dict(zip(tfp[occ].tolist(), tpl[occ].tolist()))
@@ -101,9 +100,8 @@ def test_bucket_overflow_is_clean():
     state = fresh(nbuckets)
     state, _, n_new, overflow = insert(state, fps)
     assert overflow
-    # nothing was written: the table and counts are untouched
+    # nothing was written: the table is untouched
     assert table_contents(state) == {}
-    assert int(np.asarray(state[2]).sum()) == 0
 
 
 def test_window_chunking_covers_large_batches():
@@ -153,7 +151,6 @@ def test_cand_overflow_writes_nothing():
     )
     assert overflow and n_new == 0 and len(inserted) == 0
     assert table_contents(state) == {}
-    assert int(np.asarray(state[2]).sum()) == 0
     # and the same stream succeeds once the budget covers it
     state, _, n_new, overflow = insert(state, fps, window=8, compact=64)
     assert not overflow and n_new == 40
@@ -166,11 +163,10 @@ def test_compacted_generation_order_is_preserved():
     fps = np.array(
         [int(EMPTY), 901, int(EMPTY), 17, 445, int(EMPTY), 23], np.uint64
     )
-    tfp, tpl, cnt = state
-    tfp, tpl, cnt, sel, n_new, ofl, cofl = bucket_insert(
+    tfp, tpl = state
+    tfp, tpl, sel, n_new, ofl, cofl = bucket_insert(
         tfp,
         tpl,
-        cnt,
         jnp.asarray(fps),
         jnp.asarray(fps),
         window=4,
@@ -190,16 +186,17 @@ def test_host_rehash_round_trip():
     state, _, n_new, overflow = insert(state, fps, window=64)
     assert not overflow
     before = table_contents(state)
-    tfp, tpl, cnt = host_bucket_rehash(
+    tfp, tpl = host_bucket_rehash(
         np.asarray(state[0]), np.asarray(state[1]), 32
     )
     occ = tfp != EMPTY
     after = dict(zip(tfp[occ].tolist(), tpl[occ].tolist()))
     assert after == before
-    # counts match per-bucket occupancy
-    per_bucket = (tfp.reshape(32, SLOTS) != EMPTY).sum(axis=1)
-    assert np.array_equal(cnt, per_bucket.astype(np.uint32))
+    # slots fill densely per bucket (occupancy implicit in the table)
+    lines = tfp.reshape(32, SLOTS) != EMPTY
+    filled = lines.sum(axis=1)
+    assert all(lines[b, :filled[b]].all() for b in range(32))
     # and the rehashed table keeps accepting inserts consistently
-    state2 = (jnp.asarray(tfp), jnp.asarray(tpl), jnp.asarray(cnt))
+    state2 = (jnp.asarray(tfp), jnp.asarray(tpl))
     state2, _, n_new2, _ = insert(state2, [123456789, int(fps[0])])
     assert n_new2 == 1

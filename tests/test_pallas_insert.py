@@ -1,6 +1,6 @@
 """The Pallas visited-set insert kernel (``ops/pallas_insert.py``) must be
-bit-identical to the XLA windowed-scatter path — same tables, counts, and
-novelty verdicts — on random batches and inside the full engine.
+bit-identical to the XLA windowed-scatter path — same tables and novelty
+verdicts — on random batches and inside the full engine.
 
 On CPU the kernel runs in Pallas interpret mode; on TPU hardware it
 compiles to the real DMA kernel (bench A/Bs both paths on chip).
@@ -31,47 +31,44 @@ def test_pallas_matches_xla_insert(m, nbuckets):
     shapes = (nbuckets * SLOTS,)
     tfp_x = jnp.full(shapes, EMPTY, jnp.uint64)
     tpl_x = jnp.zeros(shapes, jnp.uint64)
-    cnt_x = jnp.zeros((nbuckets,), jnp.uint32)
-    tfp_p, tpl_p, cnt_p = tfp_x, tpl_x, cnt_x
+    tfp_p, tpl_p = tfp_x, tpl_x
 
     for round_ in range(4):
         fps, payloads = random_batch(rng, m, nbuckets)
         rx = bucket_insert(
-            tfp_x, tpl_x, cnt_x, fps, payloads, window=64, use_pallas=False
+            tfp_x, tpl_x, fps, payloads, window=64, use_pallas=False
         )
         rp = bucket_insert(
-            tfp_p, tpl_p, cnt_p, fps, payloads, window=64, use_pallas=True
+            tfp_p, tpl_p, fps, payloads, window=64, use_pallas=True
         )
-        # (tfp, tpl, cnt, sel, n_new, overflow, cand_overflow)
-        tfp_x, tpl_x, cnt_x = rx[0], rx[1], rx[2]
-        tfp_p, tpl_p, cnt_p = rp[0], rp[1], rp[2]
-        assert bool(rx[5]) == bool(rp[5]), round_  # overflow agreement
-        if bool(rx[5]):
+        # (tfp, tpl, sel, n_new, overflow, cand_overflow)
+        tfp_x, tpl_x = rx[0], rx[1]
+        tfp_p, tpl_p = rp[0], rp[1]
+        assert bool(rx[4]) == bool(rp[4]), round_  # overflow agreement
+        if bool(rx[4]):
             break
-        assert int(rx[4]) == int(rp[4])  # n_new agreement
+        assert int(rx[3]) == int(rp[3])  # n_new agreement
         # inserted-candidate selection agreement (novelty verdicts)
         np.testing.assert_array_equal(
-            np.asarray(rx[3])[: int(rx[4])], np.asarray(rp[3])[: int(rp[4])]
+            np.asarray(rx[2])[: int(rx[3])], np.asarray(rp[2])[: int(rp[3])]
         )
         np.testing.assert_array_equal(np.asarray(tfp_x), np.asarray(tfp_p))
         np.testing.assert_array_equal(np.asarray(tpl_x), np.asarray(tpl_p))
-        np.testing.assert_array_equal(np.asarray(cnt_x), np.asarray(cnt_p))
 
 
 def test_pallas_overflow_writes_nothing():
     nbuckets = 4
     tfp = jnp.full((nbuckets * SLOTS,), EMPTY, jnp.uint64)
     tpl = jnp.zeros((nbuckets * SLOTS,), jnp.uint64)
-    cnt = jnp.zeros((nbuckets,), jnp.uint32)
     # >SLOTS distinct fps in one bucket: guaranteed overflow
     fps = jnp.asarray(
         (np.arange(1, SLOTS + 2, dtype=np.uint64) * nbuckets), jnp.uint64
     )
     payloads = jnp.arange(SLOTS + 1, dtype=jnp.uint64)
-    out = bucket_insert(tfp, tpl, cnt, fps, payloads, window=8, use_pallas=True)
-    assert bool(out[5]) and int(out[4]) == 0  # overflow, nothing counted
+    out = bucket_insert(tfp, tpl, fps, payloads, window=8, use_pallas=True)
+    assert bool(out[4]) and int(out[3]) == 0  # overflow, nothing counted
     np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(tfp))
-    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(cnt))
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(tpl))
 
 
 def test_engine_pinned_count_with_pallas():
