@@ -528,6 +528,15 @@ class CompiledActorTensor(TensorModel):
         )
 
     def init_rows(self) -> np.ndarray:
+        # Both engines call init_rows() host-side while BUILDING a run, so
+        # this is the last guaranteed outside-any-trace moment: populate the
+        # device-constant cache here.  A lazy first touch from inside a
+        # traced step would memoize trace-local tracers, and any later trace
+        # of a different engine build (e.g. after a growth event) would read
+        # another trace's tracer — UnexpectedTracerError.  Host-only users
+        # (CPU checkers fingerprinting via the twin) never call init_rows
+        # and stay numpy-only.
+        self._consts()
         return np.asarray([self.encode_state(self._init_state)], np.uint64)
 
     # -- device --------------------------------------------------------------
