@@ -147,10 +147,12 @@ def spawn(
             handle.thread = threading.Thread(
                 target=_run, args=(handle,), daemon=True
             )
-    except OSError:
+    except BaseException:
         # partial failure: no thread has started yet (so no _run/finally
         # will close anything) — release every socket bound so far, or the
-        # ports stay stuck until GC
+        # ports stay stuck until GC.  BaseException, not just OSError:
+        # Id()/to_addr() can raise for a malformed id and the earlier binds
+        # must still be released.
         for h in handles:
             if h.sock is not None:
                 h.sock.close()

@@ -78,6 +78,16 @@ class CheckerBuilder:
 
         return DfsChecker(self)
 
+    def spawn_mp_bfs(self, processes: Optional[int] = None) -> "Checker":
+        """Process-parallel BFS: real multi-core checking (the thread pool
+        above is GIL-bound).  Fingerprint-ownership sharding over forked
+        workers — the CPU analogue of the device engines' all-to-all
+        routing; see ``checker/mp.py``.  ``processes`` defaults to
+        ``threads(N)`` if set above 1, else all cores."""
+        from .mp import MpBfsChecker
+
+        return MpBfsChecker(self, processes=processes)
+
     def spawn_tpu(self, **kw) -> "Checker":
         """The point of this framework: wavefront BFS on TPU (no reference
         counterpart; see ``stateright_tpu/parallel/wavefront.py``).

@@ -64,7 +64,13 @@ _EXPECTATION_NAME = {
 }
 
 
-_ENCODED_CACHE: dict = {}  # (id(checker), name, final fp) -> encoded path
+def _path_cache(checker) -> dict:
+    """Per-checker encoded-path cache, stored ON the checker object so it
+    dies with it.  A module-level dict keyed on ``id(checker)`` would go
+    stale when CPython reuses the address of a collected checker — a later
+    server in the same process could then serve a previous run's path for a
+    same-named property."""
+    return checker.__dict__.setdefault("_explorer_encoded_cache", {})
 
 
 def _status_view(model, checker, snapshot: _Snapshot) -> dict:
@@ -77,29 +83,31 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
     # continuously.
     raw = getattr(checker, "_discoveries", None)
     if raw is not None:
+        cache = _path_cache(checker)
         encoded = {}
         for name, fp in dict(raw).items():
-            key = (id(checker), name, fp)
-            if key not in _ENCODED_CACHE:
-                _ENCODED_CACHE[key] = Path.from_fingerprints(
+            key = (name, fp)
+            if key not in cache:
+                cache[key] = Path.from_fingerprints(
                     model, checker._trace(fp)
                 ).encode(model)
-            encoded[name] = _ENCODED_CACHE[key]
+            encoded[name] = cache[key]
     elif hasattr(checker, "live_discoveries"):
         # device engines: discovery fps ride the per-sync stats, paths
         # parent-walk a checkpointed table + re-execute the object form.
         # First-wins discovery fps never change, so reconstruction happens
         # once per discovery: cached names are passed as ``skip`` and the
         # engine takes no checkpoint at all when nothing new is recorded.
+        cache = _path_cache(checker)
         encoded = {
-            name: _ENCODED_CACHE[(id(checker), name)]
+            name: cache[name]
             for name in (p.name for p in model.properties())
-            if (id(checker), name) in _ENCODED_CACHE
+            if name in cache
         }
         fresh = checker.live_discoveries(skip=frozenset(encoded))
         for name, path in fresh.items():
-            _ENCODED_CACHE[(id(checker), name)] = path.encode(model)
-            encoded[name] = _ENCODED_CACHE[(id(checker), name)]
+            cache[name] = path.encode(model)
+            encoded[name] = cache[name]
     else:  # other strategies: full (joining) reconstruction
         encoded = {
             name: path.encode(model)

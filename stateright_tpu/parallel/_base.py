@@ -262,7 +262,9 @@ class WavefrontChecker(Checker):
                 )
         return out
 
-    def live_discoveries(self, skip: frozenset = frozenset()) -> dict[str, Path]:
+    def live_discoveries(
+        self, skip: frozenset = frozenset(), timeout: float = 5.0
+    ) -> dict[str, Path]:
         """Discoveries visible so far WITHOUT joining: the Explorer polls
         this while the device run is still in flight.  Discovery
         fingerprints ride the per-sync stats vector; the parent chain of a
@@ -271,7 +273,13 @@ class WavefrontChecker(Checker):
         snapshot sufficient to parent-walk it.  ``skip`` names properties
         the caller has already reconstructed (first-wins fps never change):
         when every recorded discovery is in ``skip``, no checkpoint is taken
-        at all, keeping repeated polls free."""
+        at all, keeping repeated polls free.
+
+        ``timeout`` bounds the snapshot wait: an Explorer poll landing in
+        the middle of a long ``steps_per_call`` device block returns ``{}``
+        and simply retries next poll instead of blocking the HTTP handler
+        (and any concurrent :meth:`checkpoint` callers queued on
+        ``_ckpt_lock``) for up to 30 s."""
         if self._done.is_set():
             return {
                 n: p for n, p in self.discoveries().items() if n not in skip
@@ -288,7 +296,7 @@ class WavefrontChecker(Checker):
         if not wanted:
             return {}
         try:
-            snap = self.checkpoint(timeout=30.0)
+            snap = self.checkpoint(timeout=timeout)
         except (TimeoutError, RuntimeError):
             return {}
         if self._done.is_set():  # finished while we snapshotted

@@ -413,12 +413,17 @@ class TpuChecker(WavefrontChecker):
             cache[key] = eng
         return eng
 
-    def _carry_to_snapshot(self, carry, cap, qcap) -> dict:
+    def _carry_to_snapshot(self, carry, cap, qcap, cand=None) -> dict:
         snap = {
             k: np.asarray(v) for k, v in zip(_SNAPSHOT_KEYS, carry)
         }
         snap["cap"], snap["qcap"], snap["batch"] = cap, qcap, self._batch
-        snap["cand"] = self._cand  # self-tuned budget survives resume
+        # self-tuned budget survives resume.  The run loop passes its LIVE
+        # cand: self._cand is only written back when the run ends, so a
+        # checkpoint taken after a mid-run _STATUS_CAND_FULL doubling would
+        # otherwise store the stale pre-growth budget and resume would
+        # replay the growth (an extra engine recompile).
+        snap["cand"] = self._cand if cand is None else cand
         snap["width"] = self.tensor.width
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
@@ -442,18 +447,22 @@ class TpuChecker(WavefrontChecker):
 
     @staticmethod
     def _grow(carry_np: list, cap: int, qcap: int, batch: int, arity: int,
-              status: int):
+              status: int, cand: int):
         """Grow whatever is (near) full; returns (cap, qcap, carry).
 
         Both conditions are always re-checked regardless of which status code
         fired: table-full and queue-full can trip in the same batch, and
         resuming with ``tail`` still past the high-water mark would let the
         next append clamp its write window onto unexpanded queue rows.
+
+        The static table bound follows the engine's actual precondition,
+        ``cap >= 4*cand`` (the candidate budget caps how many inserts one
+        step attempts) — NOT the fully padded ``4*batch*arity``, which would
+        make the first growth event of any kind inflate the table to cover a
+        width the candidate-compaction pipeline exists to avoid paying for.
         """
         def table_small():
-            return (int(carry_np[_UNIQUE]) * 4 > cap) or (
-                batch * arity * 4 > cap
-            )
+            return (int(carry_np[_UNIQUE]) * 4 > cap) or (cand * 4 > cap)
 
         if table_small() or status == _STATUS_TABLE_FULL:
             if table_small():
@@ -503,7 +512,7 @@ class TpuChecker(WavefrontChecker):
                     cand = min(cand * 2, batch * arity)
                 carry_np = [np.asarray(c) for c in carry]
                 cap, qcap, carry_np = self._grow(
-                    carry_np, cap, qcap, batch, arity, st
+                    carry_np, cap, qcap, batch, arity, st, cand
                 )
                 carry = [jnp.asarray(c) for c in carry_np]
         else:
@@ -544,7 +553,7 @@ class TpuChecker(WavefrontChecker):
             # and resume re-applies the growth (the flag travels with the
             # snapshot — see the resume branch above)
             if self._ckpt_req is not None and self._ckpt_req.is_set():
-                self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap)
+                self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap, cand)
                 self._ckpt_req.clear()
                 self._ckpt_ready.set()
             if status != _STATUS_OK:
@@ -559,14 +568,14 @@ class TpuChecker(WavefrontChecker):
                     while cand * 4 > cap:
                         cap, qcap, carry_np = self._grow(
                             [np.asarray(c) for c in carry], cap, qcap,
-                            batch, arity, _STATUS_TABLE_FULL,
+                            batch, arity, _STATUS_TABLE_FULL, cand,
                         )
                         carry = [jnp.asarray(c) for c in carry_np]
                     stats = None
                     continue
                 carry_np = [np.asarray(c) for c in carry]
                 cap, qcap, carry_np = self._grow(
-                    carry_np, cap, qcap, batch, arity, status
+                    carry_np, cap, qcap, batch, arity, status, cand
                 )
                 carry = [jnp.asarray(c) for c in carry_np]
                 stats = None

@@ -1,0 +1,85 @@
+"""Process-parallel BFS (``checker/mp.py``): parity with the thread oracle.
+
+The mp checker is the honest multi-core CPU baseline (VERDICT r3 next #3);
+its per-state semantics must be indistinguishable from ``spawn_bfs`` —
+pinned unique counts, same discoveries, valid reconstructed paths — while
+its plumbing (fp-ownership sharding, all-to-all rounds, double-barrier
+termination) is the CPU analogue of ``parallel/sharded.py``.
+"""
+
+import pytest
+
+from stateright_tpu.checker.mp import spawn_mp_bfs
+from stateright_tpu.core import Model, Property
+
+from fixtures import LinearEquation
+
+
+class TwoPhase3:
+    def __new__(cls):
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        return TwoPhaseSys(3)
+
+
+def test_mp_pinned_counts_and_discovery_parity():
+    # 2pc @ 3 RMs: 288 unique (reference examples/2pc.rs:128)
+    c = spawn_mp_bfs(TwoPhase3(), workers=3)
+    assert c.unique_state_count() == 288
+    ref = TwoPhase3().checker().spawn_bfs().join()
+    assert sorted(c.discoveries()) == sorted(ref.discoveries())
+    assert c.state_count() == ref.state_count()
+
+
+def test_mp_paths_are_valid_and_reach_discovery():
+    m = LinearEquation(2, 10, 14)
+    c = spawn_mp_bfs(m, workers=2)
+    ref = m.checker().spawn_bfs().join()
+    # early exit (all properties discovered) lands at ROUND granularity in
+    # BSP, so the mp run may overshoot the thread checker's mid-block stop
+    # by up to one wavefront — same relaxation the device engines get
+    assert c.unique_state_count() >= ref.unique_state_count()
+    for name, path in c.discoveries().items():
+        prop = m.property_by_name(name)
+        # the path re-executes the model by construction (Path
+        # reconstruction raises on an invalid trace); its final state must
+        # actually witness the property
+        assert prop.condition(m, path.final_state())
+
+
+def test_mp_target_states_stops_early():
+    # 0x + 0y = 1 is unsolvable, so only the target can stop the run short
+    # of the full 65,536-state space
+    c = spawn_mp_bfs(LinearEquation(0, 0, 1), workers=2,
+                     target_states=500)
+    # BSP rounds overshoot by at most one wavefront, never undershoot
+    assert 500 <= c.unique_state_count() < 65_536
+
+
+class _Exploding(Model):
+    def init_states(self):
+        return [0]
+
+    def actions(self, state):
+        return [1]
+
+    def next_state(self, state, action):
+        if state >= 3:
+            raise RuntimeError("model bug at depth 3")
+        return state + action
+
+    def properties(self):
+        return [Property.always("fine", lambda m, s: True)]
+
+
+def test_mp_worker_error_propagates():
+    with pytest.raises(RuntimeError, match="model bug at depth 3"):
+        spawn_mp_bfs(_Exploding(), workers=2)
+
+
+def test_mp_rejects_visitor_and_symmetry():
+    from stateright_tpu.checker.visitor import StateRecorder
+
+    b = LinearEquation(1, 2, 3).checker().visitor(StateRecorder())
+    with pytest.raises(ValueError, match="visitor"):
+        b.spawn_mp_bfs()
