@@ -524,8 +524,7 @@ class Probe:
     time CPU numbers are in we know whether the backend is reachable —
     without having burned any serial wall-clock on it."""
 
-    def __init__(self, timeout_s: float):
-        self.timeout_s = timeout_s
+    def __init__(self):
         self.t0 = time.monotonic()
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--tpu-probe"],
@@ -533,7 +532,6 @@ class Probe:
             stderr=subprocess.PIPE,
             text=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
-            env=dict(os.environ, BENCH_PROBE_TIMEOUT=str(int(timeout_s))),
         )
 
     def result(self, wait_s: float) -> dict:
@@ -767,10 +765,11 @@ def main() -> int:
             print(json.dumps(partial))
             return 1
 
-    # the probe starts FIRST and runs concurrently with the CPU phase
-    probe = Probe(timeout_s=float(
-        os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120")
-    ))
+    # the probe starts FIRST and runs concurrently with the CPU phase; its
+    # lifetime is cpu-phase duration + the short result() wait below — a
+    # hung probe never delays the first full attempt, whose own init
+    # watchdog covers the hang
+    probe = Probe()
     try:
         emit(**cpu_phase())  # line 1: the artifact can never again be empty
     except Exception as e:  # noqa: BLE001 - CPU numbers lost, TPU still runs

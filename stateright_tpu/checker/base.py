@@ -242,6 +242,30 @@ class Checker:
         raise AssertionError(last_err)
 
 
+class ParentPointerTrace:
+    """Path reconstruction shared by checkers whose visited map stores
+    ``child_fp -> parent_fp`` with root sentinel 0 (thread BFS and mp BFS;
+    reference ``bfs.rs:314-342``).  Requires ``self.model``,
+    ``self._generated`` (the parent-pointer map) and ``self._discoveries``
+    (property name -> discovery fp)."""
+
+    def _trace(self, fp: int) -> list[int]:
+        fps = [fp]
+        while True:
+            parent = self._generated.get(fps[-1], 0)
+            if parent == 0:
+                break
+            fps.append(parent)
+        fps.reverse()
+        return fps
+
+    def discoveries(self) -> dict[str, Path]:
+        return {
+            name: Path.from_fingerprints(self.model, self._trace(fp))
+            for name, fp in dict(self._discoveries).items()
+        }
+
+
 def evaluate_properties(
     model, props: Sequence[Property], discoveries: dict, state, ebits, token
 ):

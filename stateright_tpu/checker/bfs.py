@@ -28,6 +28,7 @@ from collections import deque
 from .base import (
     CheckerBuilder,
     JOB_BLOCK_SIZE,
+    ParentPointerTrace,
     evaluate_properties,
     flush_terminal_ebits,
     init_ebits,
@@ -36,7 +37,7 @@ from .path import Path
 from .pool import WorkerPoolChecker
 
 
-class BfsChecker(WorkerPoolChecker):
+class BfsChecker(ParentPointerTrace, WorkerPoolChecker):
     def __init__(self, options: CheckerBuilder):
         self.model = options.model
         self._props = list(self.model.properties())
@@ -116,25 +117,7 @@ class BfsChecker(WorkerPoolChecker):
                 break
         self._add_count(local_count)
 
-    # -- path reconstruction -------------------------------------------------
-
-    def _trace(self, fp: int) -> list[int]:
-        fps = [fp]
-        while True:
-            parent = self._generated.get(fps[-1], 0)
-            if parent == 0:
-                break
-            fps.append(parent)
-        fps.reverse()
-        return fps
-
-    # -- Checker surface -----------------------------------------------------
+    # -- Checker surface (paths via ParentPointerTrace) ----------------------
 
     def unique_state_count(self) -> int:
         return len(self._generated)
-
-    def discoveries(self) -> dict[str, Path]:
-        return {
-            name: Path.from_fingerprints(self.model, self._trace(fp))
-            for name, fp in dict(self._discoveries).items()
-        }

@@ -47,18 +47,18 @@ from typing import Optional
 from .base import (
     Checker,
     CheckerBuilder,
+    ParentPointerTrace,
     evaluate_properties,
     flush_terminal_ebits,
     init_ebits,
 )
-from .path import Path
 
 # shared-stats columns, per worker
 _FRONTIER, _UNIQUE, _COUNT, _DISC, _STOP = range(5)
 _NCOL = 5
 
 
-class MpBfsChecker(Checker):
+class MpBfsChecker(ParentPointerTrace, Checker):
     """Checker surface over a completed process-parallel run.
 
     The run happens synchronously in the constructor (workers fork, explore,
@@ -75,8 +75,14 @@ class MpBfsChecker(Checker):
             raise ValueError("mp BFS does not support symmetry; use spawn_dfs")
         self.model = options.model
         self._props = list(self.model.properties())
-        n = processes or options.thread_count
-        if n <= 1:
+        # an EXPLICIT processes count wins verbatim (processes=1 is a valid
+        # single-worker debugging run); only the unset case falls through to
+        # threads(N) and then to all cores
+        if processes is not None:
+            n = max(1, processes)
+        elif options.thread_count > 1:
+            n = options.thread_count
+        else:
             n = os.cpu_count() or 1
         self.worker_count = n
         ctx = mp.get_context("fork")
@@ -163,21 +169,7 @@ class MpBfsChecker(Checker):
     def is_done(self) -> bool:
         return True
 
-    def _trace(self, fp: int) -> list[int]:
-        fps = [fp]
-        while True:
-            parent = self._generated.get(fps[-1], 0)
-            if parent == 0:
-                break
-            fps.append(parent)
-        fps.reverse()
-        return fps
-
-    def discoveries(self) -> dict[str, Path]:
-        return {
-            name: Path.from_fingerprints(self.model, self._trace(fp))
-            for name, fp in self._discoveries.items()
-        }
+    # discoveries()/_trace() via ParentPointerTrace
 
 
 def _worker_main(

@@ -10,9 +10,12 @@ executed by ``tests/test_quickstart.py`` so they double as specs here too.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+
 from .. import Expectation, Property
-from ..actor import Actor, ActorModel, Id, Out
+from ..actor import Actor, ActorModel, Id, Network, Out
 from ..core import Model
+from ..utils.vector_clock import VectorClock
 
 GOAL = (0, 1, 2, 3, 4, 5, 6, 7, 8)
 
@@ -98,6 +101,64 @@ def clock_counterexample(limit: int = 3):
     """Returns the trace on which a clock first reaches ``limit``."""
     checker = clock_model(limit).checker().spawn_bfs().join()
     return checker.discovery("less than max")
+
+
+# -- vector clocks: detecting concurrency -------------------------------------
+
+
+@dataclass(frozen=True)
+class ObserverState:
+    """The observer's merged clock plus whether any delivery was causally
+    concurrent with what it had already seen."""
+
+    clock: VectorClock
+    saw_concurrent: bool = False
+
+
+class StampedSender(Actor):
+    """Emits a single event stamped with its vector clock
+    (``VectorClock.incremented``, reference ``vector_clock.rs:34-40``)."""
+
+    def __init__(self, observer: Id):
+        self.observer = observer
+
+    def on_start(self, id: Id, out: Out):
+        clock = VectorClock().incremented(int(id))
+        out.send(self.observer, clock)
+        return clock
+
+
+class ClockObserver(Actor):
+    """Merges incoming clocks (``merge_max``) and flags deliveries that are
+    incomparable with its current knowledge (``partial_cmp`` → ``None``),
+    i.e. causally concurrent events."""
+
+    def on_start(self, id: Id, out: Out):
+        return ObserverState(VectorClock())
+
+    def on_msg(self, id: Id, state: ObserverState, src: Id, msg, out: Out):
+        concurrent = msg.partial_cmp(state.clock) is None
+        merged = state.clock.merge_max(msg).incremented(int(id))
+        return ObserverState(merged, state.saw_concurrent or concurrent)
+
+
+def vector_clock_model() -> ActorModel:
+    """Two independent senders + one observer: the checker proves the two
+    events are concurrent (neither causally precedes the other) by
+    discovering an observer state with ``saw_concurrent`` set."""
+    m = ActorModel(cfg=None)
+    m.actor(StampedSender(observer=Id(2)))
+    m.actor(StampedSender(observer=Id(2)))
+    m.actor(ClockObserver())
+    # non-duplicating: the observer bumps its clock per delivery, so under
+    # the (default) duplicating network redelivery would grow states forever
+    m.init_network_(Network.new_unordered_nonduplicating())
+    m.property(
+        Expectation.SOMETIMES,
+        "concurrency detected",
+        lambda model, s: s.actor_states[2].saw_concurrent,
+    )
+    return m
 
 
 def main() -> None:

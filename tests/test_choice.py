@@ -1,0 +1,114 @@
+"""Explicit ``Choice`` composition of three differently-typed actors.
+
+Mirrors the reference's 3-way choice test (``src/actor/model.rs:862-977``):
+actor A holds a wrapping byte counter, B a character, C a string — three
+different state types behind one message vocabulary — in a ring
+A -> B -> C -> A started by C, checked under DFS with a
+:class:`StateRecorder`, and the exact visit sequence is pinned.
+"""
+
+from stateright_tpu.actor import Actor, ActorModel, Id, Network
+from stateright_tpu.actor.choice import Choice, ChoiceState
+from stateright_tpu.checker.visitor import StateRecorder
+from stateright_tpu.core import Expectation
+
+
+class A(Actor):  # u8-style wrapping counter (model.rs:869-881)
+    def __init__(self, b: Id):
+        self.b = b
+
+    def on_start(self, id, out):
+        return 1
+
+    def on_msg(self, id, state, src, msg, out):
+        out.send(self.b, msg)
+        return (state + 1) % 256
+
+
+class B(Actor):  # char state (model.rs:884-897)
+    def __init__(self, c: Id):
+        self.c = c
+
+    def on_start(self, id, out):
+        return "a"
+
+    def on_msg(self, id, state, src, msg, out):
+        out.send(self.c, msg)
+        return chr((ord(state) + 1) % 256)
+
+
+class C(Actor):  # string state; kicks off the ring (model.rs:899-913)
+    def __init__(self, a: Id):
+        self.a = a
+
+    def on_start(self, id, out):
+        out.send(self.a, ())
+        return "I"
+
+    def on_msg(self, id, state, src, msg, out):
+        out.send(self.a, msg)
+        return state + "I"
+
+
+def _sys():
+    return (
+        ActorModel(cfg=None, init_history=0)
+        .actor(Choice.new(A(Id(1))))
+        .actor(Choice.new(B(Id(2))).or_())
+        .actor(Choice.new(C(Id(0))).or_().or_())
+        .init_network_(Network.new_unordered_nonduplicating())
+        .record_msg_out(lambda cfg, out_count, env: out_count + 1)
+        .property(Expectation.ALWAYS, "true", lambda m, s: True)
+        .within_boundary_(lambda cfg, state: state.history < 8)
+    )
+
+
+def test_choice_correctly_implements_actor():
+    """Exact DFS visit sequence parity with ``model.rs:914-977``."""
+    recorder = StateRecorder()
+    _sys().checker().visitor(recorder).spawn_dfs().join()
+    states = [tuple(s.actor_states) for s in recorder.states]
+    expected = [
+        # Init.
+        (ChoiceState(0, 1), ChoiceState(1, "a"), ChoiceState(2, "I")),
+        # Then deliver to A.
+        (ChoiceState(0, 2), ChoiceState(1, "a"), ChoiceState(2, "I")),
+        # Then deliver to B.
+        (ChoiceState(0, 2), ChoiceState(1, "b"), ChoiceState(2, "I")),
+        # Then deliver to C.
+        (ChoiceState(0, 2), ChoiceState(1, "b"), ChoiceState(2, "II")),
+        # Then deliver to A again.
+        (ChoiceState(0, 3), ChoiceState(1, "b"), ChoiceState(2, "II")),
+        # Then deliver to B again.
+        (ChoiceState(0, 3), ChoiceState(1, "c"), ChoiceState(2, "II")),
+        # Then deliver to C again.
+        (ChoiceState(0, 3), ChoiceState(1, "c"), ChoiceState(2, "III")),
+    ]
+    assert states == expected
+
+
+def test_choice_tags_disambiguate_equal_inner_states():
+    """Two variants over identical inner states are distinct values — the
+    combinator's entire reason to exist (reference nested L/R tags)."""
+    s0 = ChoiceState(0, 1)
+    s1 = ChoiceState(1, 1)
+    assert s0 != s1 and hash(s0) != hash(s1)
+    from stateright_tpu.fingerprint import fingerprint
+
+    assert fingerprint(s0) != fingerprint(s1)
+
+
+def test_choice_noop_is_preserved():
+    """A wrapped no-op handler result stays a no-op so the model still
+    prunes it (reference model.rs:253-260 pruning semantics)."""
+
+    class Quiet(Actor):
+        def on_start(self, id, out):
+            return 0
+
+    from stateright_tpu.actor import Out
+
+    c = Choice.new(Quiet()).or_()
+    out = Out()
+    assert c.on_msg(Id(0), ChoiceState(1, 0), Id(1), (), out) is None
+    assert len(out) == 0

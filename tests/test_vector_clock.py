@@ -1,0 +1,93 @@
+"""VectorClock semantics parity (reference ``src/util/vector_clock.rs:110-273``)
+plus a model-checked caller (``vector_clock_model`` in quickstart).
+
+The load-bearing property throughout is zero-suffix insensitivity: clocks
+over different actor counts must equate/hash/order as if padded with zeros
+(reference ``vector_clock.rs:54-106``).
+"""
+
+import pytest
+
+from stateright_tpu.fingerprint import fingerprint
+from stateright_tpu.utils.vector_clock import VectorClock
+
+
+def test_can_equate():
+    # vector_clock.rs:128-145
+    assert VectorClock() == VectorClock()
+    assert VectorClock([0]) == VectorClock([])
+    assert VectorClock([]) == VectorClock([0])
+    assert VectorClock([]) != VectorClock([1])
+    assert VectorClock([1]) != VectorClock([])
+
+
+def test_can_hash():
+    # vector_clock.rs:148-187: equal ⇒ equal hash (incl. zero suffixes);
+    # fingerprints must agree too — clocks live inside model state.
+    assert hash(VectorClock()) == hash(VectorClock())
+    assert hash(VectorClock([])) == hash(VectorClock([0, 0]))
+    assert hash(VectorClock([1])) == hash(VectorClock([1, 0]))
+    assert fingerprint(VectorClock([1])) == fingerprint(VectorClock([1, 0]))
+    assert hash(VectorClock([])) != hash(VectorClock([1]))
+    assert fingerprint(VectorClock([])) != fingerprint(VectorClock([1]))
+
+
+def test_can_increment():
+    # vector_clock.rs:191-199
+    assert VectorClock().incremented(2) == VectorClock([0, 0, 1])
+    assert (
+        VectorClock().incremented(2).incremented(0).incremented(2)
+        == VectorClock([1, 0, 2])
+    )
+
+
+def test_can_merge():
+    # vector_clock.rs:201-212
+    assert VectorClock([1, 2, 3, 4]).merge_max(
+        VectorClock([5, 6, 0])
+    ) == VectorClock([5, 6, 3, 4])
+    assert VectorClock([1, 0, 2]).merge_max(
+        VectorClock([3, 1, 0, 4])
+    ) == VectorClock([3, 1, 2, 4])
+
+
+@pytest.mark.parametrize(
+    "a, b, expected",
+    [
+        # equal (missing elements implicitly zero) — vector_clock.rs:217-230
+        ([], [], 0),
+        ([], [0, 0], 0),
+        ([0, 0], [], 0),
+        ([1, 2, 0], [1, 2], 0),
+        # less — vector_clock.rs:232-245
+        ([], [1], -1),
+        ([1, 2, 3], [1, 3, 4], -1),
+        ([1, 2, 3], [1, 3, 3], -1),
+        ([1, 2, 3], [2, 3, 3], -1),
+        # greater — vector_clock.rs:247-260
+        ([1], [], 1),
+        ([1, 2, 3], [1, 1, 2], 1),
+        ([1, 2, 3], [1, 1, 3], 1),
+        ([1, 2, 4], [0, 1, 3], 1),
+        # incomparable — vector_clock.rs:262-271
+        ([1, 2, 3], [1, 3, 2], None),
+        ([1, 2, 3], [3, 2, 1], None),
+        ([1, 2, 2], [2, 1, 2], None),
+    ],
+)
+def test_can_order_partially(a, b, expected):
+    assert VectorClock(a).partial_cmp(VectorClock(b)) == expected
+
+
+def test_model_checker_detects_concurrency():
+    """The quickstart vector-clock system: two causally independent events
+    reach the observer; the checker discovers the concurrency witness."""
+    from stateright_tpu.models.quickstart import vector_clock_model
+
+    checker = vector_clock_model().checker().spawn_bfs().join()
+    checker.assert_any_discovery("concurrency detected")
+    final = checker.discovery("concurrency detected").final_state()
+    assert final.actor_states[2].saw_concurrent
+    # both sender events are merged into the observer's clock
+    obs = final.actor_states[2].clock
+    assert obs.get(0) == 1 and obs.get(1) == 1
