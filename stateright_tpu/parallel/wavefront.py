@@ -351,10 +351,11 @@ class TpuChecker(WavefrontChecker):
     ``resume`` — a snapshot from :meth:`checkpoint` to continue from.
     ``pallas`` — use the Pallas DMA insert kernel for the visited set
     (``ops/pallas_insert.py``); default is the env knob
-    ``STATERIGHT_TPU_PALLAS=1`` (off otherwise).  Measured on v5e (r3,
-    paxos-3, batch 2048): XLA windowed scatter 233k states/s vs Pallas 102k
-    with exact count parity — the kernel's per-candidate DMA walk is serial
-    where XLA's chunked scatters pipeline, so XLA stays the default on data,
+    ``STATERIGHT_TPU_PALLAS=1`` (off otherwise).  Measured on v5e (r4,
+    paxos-3, batch 2048): XLA windowed scatter 266.7k states/s vs Pallas
+    95.7k with exact count parity — tile-granularity DMA read-modify-write
+    loses to the native scatter at ~1-candidate-per-block density
+    (``docs/pallas-insert-verdict.md``), so XLA stays the default on data,
     not caution.  The bench A/B re-measures every run and reports whichever
     path wins (``bench.py``).
     Single-device engine only: the sharded engine has its own insert and
