@@ -13,9 +13,12 @@ Differences from the reference, deliberately Pythonic:
  - Handlers return the new state instead of mutating a ``Cow``; returning
    ``None`` (with no commands) marks the no-op transitions the model prunes
    (reference ``actor.rs:238-240``).  States must be immutable values.
- - Heterogeneous actor systems need no ``Choice`` combinator
+ - Heterogeneous actor systems rarely need a ``Choice`` combinator
    (reference ``actor.rs:298-426``): ``ActorModel.actors`` may freely mix
-   actor classes that share a message vocabulary.
+   actor classes that share a message vocabulary.  The explicit combinator
+   still exists (``actor/choice.py``) for the case the reference built it
+   for — wrapping differently-typed actors whose states could otherwise
+   collide as equal values — with variant-tagged states.
 """
 
 from __future__ import annotations

@@ -11,4 +11,3 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from .hashing import EMPTY, row_hash  # noqa: E402,F401
-from .hashtable import hash_insert  # noqa: E402,F401

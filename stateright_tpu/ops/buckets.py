@@ -1,7 +1,8 @@
 """Bucketized device visited-set: one-shot insert, no probe loop.
 
-The round-1 visited set (``ops/hashtable.py``) was open addressing with a
-``lax.while_loop`` claim protocol; on real TPU hardware each probe iteration
+The round-1 visited set (an open-addressing table with a ``lax.while_loop``
+scatter-min claim protocol, since removed) probed per conflict; on real TPU
+hardware each probe iteration
 costs a full-size scatter (~6 ms per 61k-candidate scatter on v5e), and the
 loop runs for the *longest* probe chain in the batch — measured ~600 ms per
 batch, 50× the cost of everything else combined.  XLA scatters on TPU are

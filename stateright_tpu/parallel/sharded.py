@@ -197,7 +197,7 @@ def _build_sharded_run(
                            cand_ebits, compact=None):
         """Dedup candidates, claim table slots (bucketized one-shot insert —
         same visited-set as the single-device engine, ``ops/buckets.py``;
-        the round-1 probe-loop ``hash_insert`` cost a full-size scatter per
+        the round-1 probe-loop insert cost a full-size scatter per
         probe iteration on real TPU), compact novel rows into a
         frontier-shaped (exactly ``fcap_local``-row) buffer.  ``compact``
         is the valid-candidate budget (see ``bucket_insert``) — the insert

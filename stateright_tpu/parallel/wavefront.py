@@ -14,8 +14,8 @@ with data-parallelism over states.  The engine keeps a device-resident FIFO
     counterexamples (``bfs.rs:265-272``; the reference's documented DAG-join /
     cycle caveats are replicated since ebits are not fingerprinted);
  4. fingerprints all successors, dedupes the batch (sort + first-occurrence
-    mask), and inserts into the HBM hash table (``ops/hashtable.py``), which
-    stores the parent fingerprint per slot — the device analogue of the
+    mask), and inserts into the HBM bucketized table (``ops/buckets.py``),
+    which stores the parent fingerprint per slot — the device analogue of the
     reference's ``DashMap<Fingerprint, Option<Fingerprint>>`` (``bfs.rs:26``);
  5. appends the novel survivors at the queue tail.
 

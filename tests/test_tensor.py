@@ -11,8 +11,7 @@ import pytest
 import jax.numpy as jnp
 
 from stateright_tpu.fingerprint import MASK64, hash_words
-from stateright_tpu.ops import EMPTY, hash_insert, row_hash
-from stateright_tpu.ops.hashtable import dedupe_sorted
+from stateright_tpu.ops import EMPTY, row_hash
 from stateright_tpu.parallel import BitPacker
 
 
@@ -53,65 +52,3 @@ def test_bitpacker_rejects_out_of_range():
         pk.pack(y=1)
 
 
-def test_dedupe_sorted_marks_first_occurrences():
-    fps = jnp.asarray(
-        np.asarray([9, 3, 9, int(MASK64), 3, 7], np.uint64)
-    )
-    order, first = dedupe_sorted(fps)
-    sorted_fps = np.asarray(fps)[np.asarray(order)]
-    firsts = np.asarray(first)
-    kept = sorted_fps[firsts].tolist()
-    assert sorted(kept) == [3, 7, 9]  # EMPTY masked out, dups masked out
-
-
-def test_hash_insert_dedupes_and_reports_novelty():
-    cap = 16
-    tfp = jnp.full((cap,), EMPTY, jnp.uint64)
-    tpl = jnp.zeros((cap,), jnp.uint64)
-    fps = jnp.asarray(np.asarray([10, 20, 30], np.uint64))
-    pay = jnp.asarray(np.asarray([1, 2, 3], np.uint64))
-    valid = jnp.ones((3,), bool)
-    tfp, tpl, novel, overflow = hash_insert(tfp, tpl, fps, pay, valid)
-    assert np.asarray(novel).all() and not bool(overflow)
-    # re-insert: all duplicates now
-    tfp, tpl, novel, overflow = hash_insert(tfp, tpl, fps, pay, valid)
-    assert not np.asarray(novel).any()
-    # payloads of the original insert survived
-    table = np.asarray(tfp)
-    payload = np.asarray(tpl)
-    stored = {int(f): int(p) for f, p in zip(table, payload) if f != MASK64}
-    assert stored == {10: 1, 20: 2, 30: 3}
-
-
-def test_hash_insert_handles_slot_collisions():
-    # Force many fps into the same home slot (same low bits): linear probing
-    # must place them all.
-    cap = 32
-    tfp = jnp.full((cap,), EMPTY, jnp.uint64)
-    tpl = jnp.zeros((cap,), jnp.uint64)
-    n = 8
-    fps_np = np.asarray([(i << 32) | 5 for i in range(1, n + 1)], np.uint64)
-    fps = jnp.asarray(fps_np)  # all home to slot 5
-    pay = jnp.asarray(np.arange(1, n + 1, dtype=np.uint64))
-    tfp, tpl, novel, overflow = hash_insert(
-        tfp, tpl, fps, pay, jnp.ones((n,), bool)
-    )
-    assert np.asarray(novel).all() and not bool(overflow)
-    stored = {
-        int(f): int(p)
-        for f, p in zip(np.asarray(tfp), np.asarray(tpl))
-        if f != MASK64
-    }
-    assert stored == {int(f): int(p) for f, p in zip(fps_np, pay)}
-
-
-def test_hash_insert_overflow_on_full_table():
-    cap = 4
-    tfp = jnp.full((cap,), EMPTY, jnp.uint64)
-    tpl = jnp.zeros((cap,), jnp.uint64)
-    fps = jnp.asarray(np.asarray([1, 2, 3, 4, 5, 6], np.uint64))
-    pay = jnp.zeros((6,), jnp.uint64)
-    _, _, novel, overflow = hash_insert(
-        tfp, tpl, fps, pay, jnp.ones((6,), bool)
-    )
-    assert bool(overflow)
