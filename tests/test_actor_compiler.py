@@ -72,6 +72,7 @@ def test_history_codec_roundtrip_and_verdicts():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.medium
 def test_single_copy_compiled_equivalence():
     m = single_copy_model(2, 1)
     tm = m.tensor_model()
@@ -113,6 +114,7 @@ def test_single_copy_sharded_matches():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.medium
 def test_abd_compiled_prefix_equivalence():
     m = abd_model(2, 2)
     tm = m.tensor_model()
@@ -165,6 +167,7 @@ def test_compiled_paxos_agrees_with_hand_twin():
 # -- duplicating-network compilation -----------------------------------------
 
 
+@pytest.mark.medium
 def test_single_copy_duplicating_compiled_equivalence():
     """Duplicating network (redelivery allowed; reference network.rs:203-205)
     through the mechanical compiler: full device/host parity."""
@@ -235,6 +238,7 @@ def test_bounded_models_reject_duplicating_twins():
 # -- ordered-network compilation ---------------------------------------------
 
 
+@pytest.mark.medium
 def test_single_copy_ordered_compiled_equivalence():
     """Ordered (per-pair FIFO) network through the compiler: rank-in-slot
     encoding must reproduce the object flows state-for-state."""
@@ -246,6 +250,7 @@ def test_single_copy_ordered_compiled_equivalence():
     crawl_and_check(m, tm)
 
 
+@pytest.mark.medium
 def test_abd_ordered_compiled_equivalence():
     from stateright_tpu.actor import Network
 
@@ -299,6 +304,7 @@ def test_single_copy_ordered_lossy_parity():
     assert set(cpu.discoveries()) == set(tpu.discoveries())
 
 
+@pytest.mark.medium
 def test_paxos_ordered_lossy_deep_flow_equivalence():
     """Lossy ordered paxos reaches ≥2-deep flows (e.g. prepare then accept
     queued on one pair), exercising head-only drop semantics and mid-flow

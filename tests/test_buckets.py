@@ -52,6 +52,7 @@ def table_contents(state):
     return dict(zip(tfp[occ].tolist(), tpl[occ].tolist()))
 
 
+@pytest.mark.medium
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_random_stream_matches_host_set(seed):
     rng = np.random.default_rng(seed)
@@ -112,7 +113,9 @@ def test_window_chunking_covers_large_batches():
     assert sorted(table_contents(state)) == sorted(fps.tolist())
 
 
-@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "seed", [pytest.param(0, marks=pytest.mark.medium), 1]
+)
 def test_compacted_stream_matches_host_set(seed):
     """``compact=CB`` (the engines' padded-batch fast path) must agree with
     the host set exactly, including EMPTY-heavy lanes, in-batch duplicates,

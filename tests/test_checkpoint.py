@@ -19,6 +19,7 @@ def run_full(n, **kw):
     return TwoPhaseSys(n).checker().spawn_tpu(sync=True, **kw)
 
 
+@pytest.mark.medium
 def test_killed_and_resumed_2pc7_matches_uninterrupted():
     full = run_full(7)
     expected_unique = full.unique_state_count()
@@ -103,6 +104,7 @@ def test_growth_boundary_checkpoint_resume():
         resumed.assert_properties()
 
 
+@pytest.mark.medium
 def test_queue_growth_preserves_work():
     # a queue high-water mark far below the state count forces repeated
     # compaction/growth events mid-run; counts must still be exact
@@ -112,6 +114,7 @@ def test_queue_growth_preserves_work():
     checker.assert_properties()
 
 
+@pytest.mark.medium
 def test_table_growth_preserves_work():
     checker = run_full(5, capacity=1 << 8, batch=32)
     assert checker.unique_state_count() == 8832

@@ -36,7 +36,9 @@ def unpack_fields(codec: LinHistoryCodec, keys: np.ndarray):
     return phases, snaps, rvals
 
 
-@pytest.mark.parametrize("C", [1, 2, 3])
+@pytest.mark.parametrize(
+    "C", [1, 2, pytest.param(3, marks=pytest.mark.medium)]
+)
 def test_closure_matches_exhaustive_search(C):
     import jax.numpy as jnp
 

@@ -58,6 +58,7 @@ def crawl_and_check(m, tm, max_levels=None):
     return seen
 
 
+@pytest.mark.medium
 def test_paxos1_full_equivalence():
     m = paxos_model(1, 3)
     tm = m.tensor_model()
@@ -87,6 +88,7 @@ def test_paxos2_tpu_checker_pinned_count():
     checker.assert_properties()
 
 
+@pytest.mark.medium
 def test_paxos2_sharded_matches():
     m = paxos_model(2, 3)
     checker = m.checker().spawn_tpu(
@@ -162,6 +164,19 @@ def test_paxos6_prefix_equivalence():
     m = paxos_model(6, 3)
     tm = m.tensor_model()
     crawl_and_check(m, tm, max_levels=2)
+
+
+def test_paxos3_twin_equivalence_bounded():
+    """FAST-TIER pin of the flagship config's twin (the driver benchmark is
+    ``paxos check 3``): a bounded per-level crawl asserting encode/decode
+    round-trips, host=device fingerprints, successor-set equality, and
+    property-mask agreement on real C=3 rows — so the per-push tier fails
+    if the paxos-3 twin drifts, even when the full 1,194,428-state run
+    (slow tier / bench) can't validate it."""
+    m = paxos_model(3, 3)
+    tm = m.tensor_model()
+    seen = crawl_and_check(m, tm, max_levels=5)
+    assert len(seen) > 100  # depth-5 reachable set, all states cross-checked
 
 
 def test_paxos3_tpu_vs_cpu_sample():

@@ -58,6 +58,7 @@ def test_sharded_capacity_overflow_grows():
     checker.assert_properties()
 
 
+@pytest.mark.medium
 def test_sharded_growth_preserves_work_mid_flight():
     """Capacities far below the state space force mid-run growth events;
     the atomic-step + host-grow protocol must preserve all work: pinned
@@ -80,6 +81,7 @@ def test_sharded_growth_preserves_work_mid_flight():
     assert all(0 < u <= 8832 for u in uniq)
 
 
+@pytest.mark.medium
 def test_sharded_growth_boundary_checkpoint_resume():
     """A snapshot carrying a growth-boundary flag (status != OK) must grow
     on resume and still finish with pinned counts.  A checkpoint request
@@ -148,6 +150,7 @@ def test_sharded_live_progress_counters():
     assert samples == sorted(samples)
 
 
+@pytest.mark.medium
 def test_sharded_checkpoint_resume_matches_uninterrupted():
     """Stop a sharded run mid-flight, snapshot, resume on a fresh checker:
     final counts and discoveries must match the uninterrupted run."""

@@ -191,6 +191,7 @@ def test_2pc_tpu_symmetry_matches_host_oracle():
         assert m.property_by_name(name).condition(m, path.final_state())
 
 
+@pytest.mark.medium
 def test_2pc_sharded_symmetry_reduces_and_discovers():
     """The mesh engine's symmetry reduction: all-to-all routing scrambles
     enqueue order across shards, so only reduction + discovery validity are
