@@ -312,10 +312,11 @@ def tpu_phase() -> dict:
     # reused.
     target = os.environ.get("BENCH_TPU_TARGET", "")
     m3 = paxos_model(3)
-    # tuned on v5e (r3 sweep): batch 2048 beat 1024/3072/4096/8192, and
-    # 1024 device steps per host sync amortizes the ~100ms tunnel RTT
-    caps = dict(capacity=1 << 23, queue_capacity=1 << 21, batch=2048,
-                steps_per_call=1024)
+    # tuned on v5e (r4 sweep, full-enumeration runs): 4096x512 and
+    # 6144x384 edge out 2048x1024 (~307-321k vs ~303-305k states/s); all
+    # configs sit in a ±5% band, larger cand budgets consistently lose
+    caps = dict(capacity=1 << 23, queue_capacity=1 << 21, batch=4096,
+                steps_per_call=512)
 
     def spawn3():
         b = m3.checker()
