@@ -771,6 +771,19 @@ def main() -> int:
     # hung probe never delays the first full attempt, whose own init
     # watchdog covers the hang
     probe = Probe()
+    # Immunize the PARENT against a dead tunnel: the accelerator site hook
+    # force-selects jax_platforms="axon,cpu", so any stray backend touch
+    # during the CPU phase (a jnp constant, a debug print of an array)
+    # would block inside the axon client init exactly when the tunnel is
+    # down — the failure mode bench exists to survive.  Forcing "cpu"
+    # after import but before any backend init confines the parent to the
+    # host; the TPU child/probe are separate processes with default env.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - defensive only; bench works without
+        pass
     try:
         emit(**cpu_phase())  # line 1: the artifact can never again be empty
     except Exception as e:  # noqa: BLE001 - CPU numbers lost, TPU still runs

@@ -34,6 +34,8 @@ __all__ = [
     "CancelTimer",
     "Out",
     "Actor",
+    "Choice",
+    "ChoiceState",
     "ScriptedActor",
     "majority",
     "model_peers",
@@ -213,3 +215,4 @@ def model_peers(self_ix: int, count: int) -> list[Id]:
 from .network import Envelope, Network  # noqa: E402
 from .model import ActorModel, ActorModelState, Deliver, Drop, Timeout  # noqa: E402
 from .spawn import spawn  # noqa: E402
+from .choice import Choice, ChoiceState  # noqa: E402
