@@ -435,6 +435,26 @@ class ShardedTpuChecker(WavefrontChecker):
         self.growth_events: list = []
         self._init_common(options, sync)
 
+    def _host_table(self, sharded) -> np.ndarray:
+        """The final visited table as a host array.  Single-controller runs
+        read the shards directly; under multi-controller SPMD
+        (``jax.distributed``, processes each owning a slice of the mesh) the
+        shards on other hosts are not addressable, so the table is
+        all-gathered on device first — every process then reconstructs
+        identical discovery paths from its own full copy."""
+        if jax.process_count() == 1:
+            return np.asarray(sharded)
+        gather = self.__dict__.get("_gather_fn")
+        if gather is None:
+            from jax.sharding import NamedSharding
+
+            gather = jax.jit(
+                lambda t: t,
+                out_shardings=NamedSharding(self.mesh, P()),  # all-gather
+            )
+            self._gather_fn = gather  # one compile serves both tables
+        return np.asarray(jax.device_get(gather(sharded)))
+
     # -- live progress.  Growth is work-preserving (atomic steps + host-side
     # buffer transforms), so counters are monotone across growth events. ----
 
@@ -455,6 +475,10 @@ class ShardedTpuChecker(WavefrontChecker):
 
     def _pre_run_validate(self) -> None:
         if self._resume is not None:
+            # snapshot consumption feeds full host arrays to a program
+            # sharded over the global mesh — not expressible when other
+            # processes own part of that mesh
+            self._require_single_controller("resume=")
             self._check_snapshot_sig(self._resume)
             if int(self._resume["ndev"]) != self.ndev:
                 raise ValueError(
@@ -464,6 +488,31 @@ class ShardedTpuChecker(WavefrontChecker):
                 )
 
     _engine_tag = "sharded"
+
+    @staticmethod
+    def _require_single_controller(what: str) -> None:
+        """Checkpoint/stop/resume/growth are single-controller only for now:
+        the sharded carry is not addressable across hosts, and per-process
+        host events (``_stop``, ``_ckpt_req``) would break the lockstep
+        invariant that every controller issues the same collectives.  Raised
+        from the CALLER-facing entry points so a multi-controller user gets
+        the error, not a dead run thread."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                f"{what} is single-controller only: the sharded carry is "
+                "not addressable across hosts and per-process control "
+                "events would desynchronize the controllers' collectives. "
+                "Pre-size capacity/frontier_capacity and let multi-host "
+                "runs complete."
+            )
+
+    def checkpoint(self, timeout=60.0) -> dict:
+        self._require_single_controller("checkpoint()")
+        return super().checkpoint(timeout=timeout)
+
+    def stop(self):
+        self._require_single_controller("stop()")
+        return super().stop()
 
     def _carry_to_snapshot(self, carry, more, cap, fcap, bf, cf) -> dict:
         snap = {
@@ -632,7 +681,10 @@ class ShardedTpuChecker(WavefrontChecker):
                         bf *= 2
                 else:
                     # mid-run overflow: the atomic step rolled back, so the
-                    # carry is consistent — grow host-side and resume
+                    # carry is consistent — grow host-side and resume.
+                    # Lockstep-safe to raise here multi-controller: status is
+                    # replicated, so EVERY controller takes this branch.
+                    self._require_single_controller("mid-run growth")
                     self.growth_events.append((status, unique))
                     carry_np = [np.asarray(c) for c in jax.device_get(carry)]
                     cap, fcap, bf, cf, carry_np = self._grow_carry(
@@ -648,8 +700,8 @@ class ShardedTpuChecker(WavefrontChecker):
             "states": scount,
             "disc": np.asarray(carry[7]),
             "depth": depth,
-            "table_fp": np.asarray(carry[0]),
-            "table_parent": np.asarray(carry[1]),
+            "table_fp": self._host_table(carry[0]),
+            "table_parent": self._host_table(carry[1]),
         }
         # keep the final carry device-resident; a stopped run's snapshot
         # keeps more=1 so resume continues it (see _final_snapshot)

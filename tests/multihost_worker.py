@@ -1,0 +1,58 @@
+"""Worker for the multi-controller (multi-host SPMD) sharded-engine test.
+
+Launched as ``python multihost_worker.py <process_id> <num_processes>
+<coordinator_port>`` by ``tests/test_multihost.py``.  Each process owns 4
+virtual CPU devices; together they form one 8-device global mesh — the
+same controller topology as a real multi-host TPU pod slice over ICI/DCN
+(one process per host, `jax.distributed` for the control plane, XLA
+collectives for data).
+
+Every process runs the identical SPMD program: the sharded wavefront
+engine's host loop reads only replicated scalars, so all controllers make
+the same decisions in lockstep, and the final table is all-gathered so
+each process reconstructs the same discovery paths locally.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 4 * nproc, jax.devices()
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    m = TwoPhaseSys(3)
+    checker = m.checker().spawn_tpu(
+        mesh=None,
+        n_devices=4 * nproc,  # the full global mesh, spanning both processes
+        sync=True,
+        # pre-sized: mid-run growth is single-controller only
+        capacity=1 << 13,
+        frontier_capacity=1 << 9,
+    )
+    assert checker.unique_state_count() == 288, checker.unique_state_count()
+    discs = checker.discoveries()
+    assert set(discs) == {"abort agreement", "commit agreement"}, discs
+    # each controller reconstructs full paths from its all-gathered table
+    for name, path in discs.items():
+        checker.assert_discovery(name, list(path.actions()))
+    print(f"multihost-worker-ok p{pid}: unique=288 discoveries={sorted(discs)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
