@@ -81,6 +81,14 @@ class WavefrontChecker(Checker):
         self._results = None
         self._parent_map: Optional[dict[int, int]] = None
         self._done = threading.Event()
+        # builder timeout parity (reference: the pool checkers' deadline):
+        # a timer requests a cooperative stop, honored at the next host
+        # sync — the run ends cleanly with partial counts and a resumable
+        # final snapshot, exactly like stop()
+        if options.timeout_secs is not None:
+            timer = threading.Timer(options.timeout_secs, self._stop.set)
+            timer.daemon = True
+            timer.start()
         self._thread = None
         # Fail fast on caller errors (e.g. a resume snapshot from a different
         # model) in the caller's thread: raised inside the daemon worker they

@@ -417,6 +417,11 @@ class ShardedTpuChecker(WavefrontChecker):
                 "the Pallas insert kernel is single-device only for now; "
                 "drop pallas=True or use spawn_tpu() without devices/mesh"
             )
+        if options.timeout_secs is not None:
+            # timers fire per process at slightly different instants — one
+            # controller would break the lockstep collectives while others
+            # keep stepping
+            self._require_single_controller("timeout()")
         self._resume = resume
         self.mesh = mesh if mesh is not None else default_mesh(n_devices)
         self.ndev = self.mesh.shape[AXIS]

@@ -126,6 +126,23 @@ def test_tpu_checker_target_state_count():
     assert 1000 <= checker.unique_state_count() < 8832
 
 
+def test_tpu_checker_honors_builder_timeout():
+    """``timeout()`` parity with the pool checkers: the device run stops
+    cooperatively at a host sync with partial counts, and its final
+    snapshot resumes to the full space (a timed-out run loses no work)."""
+    sys = TwoPhaseSys(5)
+    c = sys.checker().timeout(0.0).spawn_tpu(
+        sync=True, steps_per_call=1, frontier_capacity=1 << 6
+    )
+    assert c.is_done()
+    assert c.unique_state_count() < 8832
+    snap = c.checkpoint()
+    resumed = sys.checker().spawn_tpu(
+        sync=True, steps_per_call=1, frontier_capacity=1 << 6, resume=snap
+    )
+    assert resumed.unique_state_count() == 8832
+
+
 def test_tpu_checker_requires_tensor_form():
     from stateright_tpu import Model
 
