@@ -1,0 +1,219 @@
+"""Raft leader election, model-checked on host and device.
+
+Beyond the reference's example set (it ships no Raft): this model
+demonstrates the actor compiler's *general* fragment — timeout-driven
+actors with no auxiliary history, checked against factored properties
+(``actor/device_props.py``) — compiling mechanically to a TPU twin with
+zero hand-written device code.
+
+The protocol is the election core of Raft (Ongaro & Ousterhout §5.2):
+followers time out and become candidates, candidates solicit votes for a
+fresh term, a majority elects a leader.  Terms are bounded by
+``max_term`` so the space is finite: a server whose election timer fires
+at the cap simply stops campaigning (its timer clears and is never
+re-armed — the reference's timeout semantics make that a real
+transition, not a pruned no-op).
+
+Checked properties:
+
+ - **election safety** (always): at most one leader per term — the
+   Figure 3 safety property, as a ``forall_actor_pairs`` predicate;
+ - **liveness witness** (sometimes): some execution elects a leader.
+
+CLI: ``python -m stateright_tpu.models.raft check [n] [network]``,
+``check-tpu``, ``explore`` — like the reference's example binaries
+(``examples/paxos.rs:314-395``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
+from ..actor.device_props import exists_actor, forall_actor_pairs
+from ..core import Expectation
+from ..parallel.tensor_model import TensorBackedModel
+from ._cli import default_threads, run_cli
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class RaftState:
+    role: int = FOLLOWER
+    term: int = 0
+    voted_for: int = -1  # candidate id this server voted for in `term`
+    votes: int = 0  # bitmask of granters (candidates only)
+
+
+class RaftServer(Actor):
+    """Election-only Raft server.
+
+    Messages: ``("req_vote", term)`` solicits, ``("grant", term)``
+    grants.  A server votes at most once per term; a candidate counting a
+    majority becomes leader and stops campaigning.
+    """
+
+    def __init__(self, peers: list[Id], cluster: int, max_term: int):
+        self.peers = peers
+        self.cluster = cluster
+        self.max_term = max_term
+
+    def on_start(self, id: Id, out: Out):
+        out.set_timer()  # election timer
+        return RaftState()
+
+    def on_timeout(self, id: Id, state: RaftState, out: Out):
+        if state.role == LEADER or state.term >= self.max_term:
+            return None  # stop campaigning (timer stays cleared)
+        term = state.term + 1
+        out.broadcast(self.peers, ("req_vote", term))
+        out.set_timer()  # elections may time out and retry
+        return RaftState(
+            role=CANDIDATE,
+            term=term,
+            voted_for=int(id),
+            votes=1 << int(id),
+        )
+
+    def on_msg(self, id: Id, state: RaftState, src: Id, msg, out: Out):
+        kind, term = msg
+        if kind == "req_vote":
+            if term > state.term:
+                # newer term: step down and grant
+                out.send(src, ("grant", term))
+                return RaftState(term=term, voted_for=int(src))
+            if (
+                term == state.term
+                and state.role == FOLLOWER
+                and state.voted_for in (-1, int(src))
+            ):
+                out.send(src, ("grant", term))
+                if state.voted_for == int(src):
+                    return None  # duplicate request, vote already recorded
+                return RaftState(term=term, voted_for=int(src))
+            return None  # stale or already voted: ignore
+        if kind == "grant":
+            if state.role != CANDIDATE or term != state.term:
+                return None  # stale grant
+            votes = state.votes | (1 << int(src))
+            if votes == state.votes:
+                return None  # duplicate grant
+            role = (
+                LEADER
+                if bin(votes).count("1") >= majority(self.cluster)
+                else CANDIDATE
+            )
+            return RaftState(
+                role=role,
+                term=state.term,
+                voted_for=state.voted_for,
+                votes=votes,
+            )
+        return None
+
+
+class RaftModel(TensorBackedModel, ActorModel):
+    """ActorModel with a mechanically compiled device twin (general
+    fragment: timers + factored properties, no history)."""
+
+    max_term = 2
+
+    def tensor_model(self):
+        from ..parallel.actor_compiler import CompileError, compile_actor_model
+
+        try:
+            return compile_actor_model(
+                self,
+                # cut the closure's over-approximation at the term cap
+                # (reachable states never cross it; poison pins that)
+                state_bound=lambda i, s: s.term <= self.max_term,
+                env_bound=lambda e: e.msg[1] <= self.max_term,
+            )
+        except (CompileError, ValueError):
+            return None
+
+
+def raft_model(
+    server_count: int = 3,
+    max_term: int = 2,
+    network: Optional[Network] = None,
+) -> ActorModel:
+    """Election-safety model: ``server_count`` servers, terms bounded by
+    ``max_term``."""
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+    m = RaftModel(cfg=None, init_history=None)
+    m.max_term = max_term
+    for i in range(server_count):
+        m.actor(
+            RaftServer(
+                peers=model_peers(i, server_count),
+                cluster=server_count,
+                max_term=max_term,
+            )
+        )
+    m.init_network_(network)
+    m.property(
+        Expectation.ALWAYS,
+        "election safety",
+        forall_actor_pairs(
+            lambda i, si, j, sj: not (
+                si.role == LEADER and sj.role == LEADER and si.term == sj.term
+            )
+        ),
+    )
+    m.property(
+        Expectation.SOMETIMES,
+        "a leader is elected",
+        exists_actor(lambda i, s: s.role == LEADER),
+    )
+    return m
+
+
+def main(argv=None) -> None:
+    def parse(rest):
+        n = int(rest[0]) if rest else 3
+        network = (
+            Network.from_name(rest[1])
+            if len(rest) > 1
+            else Network.new_unordered_nonduplicating()
+        )
+        return n, network
+
+    def check(rest):
+        n, network = parse(rest)
+        print(f"Model checking Raft leader election with {n} servers.")
+        raft_model(n, network=network).checker().threads(
+            default_threads()
+        ).spawn_bfs().report()
+
+    def check_tpu(rest):
+        n, network = parse(rest)
+        print(
+            f"Model checking Raft leader election with {n} servers on the "
+            "device wavefront engine."
+        )
+        m = raft_model(n, network=network)
+        if m.tensor_model() is None:
+            print("this configuration has no device twin; use `check` (CPU)")
+            return
+        m.checker().spawn_tpu().report()
+
+    def explore(rest):
+        n = int(rest[0]) if rest else 3
+        addr = rest[1] if len(rest) > 1 else "localhost:3000"
+        raft_model(n).checker().serve(addr)
+
+    run_cli(
+        "raft [SERVER_COUNT] [NETWORK]",
+        check,
+        check_tpu=check_tpu,
+        explore=explore,
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,25 @@
-"""Mechanical actor-system → tensor-form compiler for register workloads.
+"""Mechanical actor-system → tensor-form compiler.
 
 Round 1 proved actor systems can run on the wavefront engine with a
 hand-written 700-line device twin per protocol (``models/paxos_tensor.py``).
-This module makes that a *capability*: given any ``ActorModel`` following the
-standard register-workload shape (reference ``src/actor/register.rs`` — a set
-of protocol servers, ``RegisterClient(put_count=1)`` clients, a
-linearizability-tester history, an unordered network: non-duplicating
-multiset or duplicating set semantics, optionally lossy), it compiles the
-Python actor handlers into table-driven jittable ``step_rows``
-mechanically.  Reference transition semantics being compiled:
-``src/actor/model.rs:187-306``.
+This module makes that a *capability*: it compiles Python actor handlers
+into table-driven jittable ``step_rows`` mechanically, for two fragments
+(reference transition semantics being compiled: ``src/actor/model.rs:187-306``):
+
+ - the **register workload** (reference ``src/actor/register.rs``): protocol
+   servers + ``RegisterClient(put_count=1)`` clients, a
+   linearizability-tester history, and the standard
+   linearizable/value-chosen properties;
+ - the **general fragment** (round 4): any bounded actor system with
+   ``init_history=None`` — including **timeout-driven** actors (timer bits
+   in the row, one Timeout action per armed actor, ``SetTimer``/
+   ``CancelTimer`` effects tabulated with last-command-wins semantics) —
+   whose properties are factored predicates
+   (``actor/device_props.py``), tabulated per actor (or actor pair) over
+   the compiled state universes.  ``models/raft.py`` is the showcase.
+
+Both fragments support all three network semantics (non-duplicating
+multiset, duplicating set, per-pair ordered FIFO), optionally lossy.
 
 How: a bounded host-side closure co-enumerates
 
@@ -131,38 +141,47 @@ class CompiledActorTensor(TensorModel):
         self._caps = (max_states_per_actor, max_envelopes)
 
         self.n_actors = len(model.actors)
-        self.clients = [
-            i
-            for i, a in enumerate(model.actors)
-            if isinstance(a, RegisterClient)
-        ]
-        self.C = len(self.clients)
-        values = [
-            chr(ord("A") + int(t) - model.actors[t].server_count)
-            for t in self.clients
-        ]
-        self.hist = LinHistoryCodec(
-            self.clients,
-            values,
-            # the write-once spec models the unset register as None; the
-            # wire protocol's null stays NULL_VALUE (translated at the
-            # get_ok boundary, mirroring the WO record_returns recorder)
-            None if self._wo else NULL_VALUE,
-            tester_factory=lambda: type(model.init_history)(
-                model.init_history.init_ref_obj
-            ),
-            max_states=max_history_states,
-            write_rets=(("write_ok",), ("write_fail",))
-            if self._wo
-            else (("write_ok",),),
-        )
+        if self.general:
+            self.clients = []
+            self.C = 0
+            self.hist = None
+        else:
+            self.clients = [
+                i
+                for i, a in enumerate(model.actors)
+                if isinstance(a, RegisterClient)
+            ]
+            self.C = len(self.clients)
+            values = [
+                chr(ord("A") + int(t) - model.actors[t].server_count)
+                for t in self.clients
+            ]
+            self.hist = LinHistoryCodec(
+                self.clients,
+                values,
+                # the write-once spec models the unset register as None; the
+                # wire protocol's null stays NULL_VALUE (translated at the
+                # get_ok boundary, mirroring the WO record_returns recorder)
+                None if self._wo else NULL_VALUE,
+                tester_factory=lambda: type(model.init_history)(
+                    model.init_history.init_ref_obj
+                ),
+                max_states=max_history_states,
+                write_rets=(("write_ok",), ("write_fail",))
+                if self._wo
+                else (("write_ok",),),
+            )
 
         self._closure()
+        if self.general:
+            self._tabulate_properties()
 
         self.n_slots = n_slots if n_slots is not None else max(
             16, 4 * self.n_actors
         )
-        self.max_actions = self.n_slots * (2 if model.lossy else 1)
+        self.max_actions = self.n_slots * (2 if model.lossy else 1) + (
+            self.n_actors if self._has_timers else 0
+        )
         fields = []
         for i in range(self.n_actors):
             bits = max(1, int(np.ceil(np.log2(max(2, len(self._states[i]))))))
@@ -175,6 +194,8 @@ class CompiledActorTensor(TensorModel):
             ]
             if self.hist.wfail_bits:
                 fields.append((f"h{c}_wfail", 1))
+        if self._has_timers:
+            fields.append(("timers", self.n_actors))
         fields.append(("poison", 1))
         self.pk = BitPacker(fields)
         self.pw = self.pk.width
@@ -206,9 +227,31 @@ class CompiledActorTensor(TensorModel):
         self.ordered = isinstance(m.init_network, OrderedNetwork)
         if m._within_boundary is not _default_boundary:
             raise CompileError("custom within_boundary is not compilable")
+        if m.init_history is None:
+            # GENERAL fragment: no auxiliary history; every property must be
+            # a factored predicate the compiler can tabulate over the
+            # per-actor state universes (``actor/device_props.py``)
+            from ..actor.device_props import FactoredPredicate
+
+            self.general = True
+            self._wo = False
+            bad = sorted(
+                p.name
+                for p in m.properties()
+                if not isinstance(p.condition, FactoredPredicate)
+            )
+            if bad:
+                raise CompileError(
+                    "history-free models need factored properties "
+                    "(forall_actors/exists_actor/forall_actor_pairs/"
+                    f"exists_actor_pair); non-factored: {bad}"
+                )
+            return
+        self.general = False
         if not isinstance(m.init_history, LinearizabilityTester):
             raise CompileError(
-                "history must be a LinearizabilityTester (register workload)"
+                "history must be a LinearizabilityTester (register "
+                "workload), or None for the general fragment"
             )
         names = sorted(p.name for p in m.properties())
         if names != ["linearizable", "value chosen"]:
@@ -264,8 +307,16 @@ class CompiledActorTensor(TensorModel):
         self._state_code: list[dict] = [{} for _ in range(n)]
         self._envs: list[Envelope] = []  # code -> envelope
         self._env_code: dict[Envelope, int] = {}
-        # (i, s_code, e_code) -> (new_s_code | -1, sends tuple, poison)
+        # (i, s_code, e_code) -> (new_s_code | -1, sends, poison, timer_eff)
+        # timer_eff: -1 keep, 0 clear, 1 set (last timer command wins,
+        # mirroring sequential _process_commands)
         trans: dict[tuple, tuple] = {}
+        # (i, s_code) -> (new_s_code, sends, poison, timer_bit) — the
+        # Timeout action: reference clears the flag, then commands may
+        # re-set it (``model.rs:288-306``); never pruned (``is_no_op &&
+        # keep_timer`` is unsatisfiable, so every timeout at least clears
+        # the timer)
+        ttrans: dict[tuple, tuple] = {}
         work: deque = deque()  # ("s", i, s_code) | ("e", e_code)
 
         def add_state(i: int, s) -> tuple[int, bool]:
@@ -303,10 +354,6 @@ class CompiledActorTensor(TensorModel):
 
         # seed from the real initial system state
         (init,) = m.init_states()
-        if any(init.is_timer_set):
-            # the encoding has no timer bits and step_rows generates no
-            # Timeout actions; compiling would silently drop that branch
-            raise CompileError("timers are not compilable")
         self._init_state = init
         for i, s in enumerate(init.actor_states):
             code, ok = add_state(i, s)
@@ -338,14 +385,10 @@ class CompiledActorTensor(TensorModel):
                 # object model would crash identically, and a device run
                 # that ever takes it produces a loudly-failing poisoned row
                 # instead of a silent divergence.
-                trans[(i, s_code, e_code)] = (s_code, (), True)
+                trans[(i, s_code, e_code)] = (s_code, (), True, -1)
                 return
-            if any(
-                isinstance(c, (SetTimer, CancelTimer)) for c in out.commands
-            ):
-                raise CompileError("timers are not compilable")
             if ret is None and not out.commands:
-                trans[(i, s_code, e_code)] = (-1, (), False)
+                trans[(i, s_code, e_code)] = (-1, (), False, -1)
                 return
             new_s = s if ret is None else ret
             poison = False
@@ -356,24 +399,35 @@ class CompiledActorTensor(TensorModel):
                 # loudly-failing poisoned row on device, never as a silently
                 # pruned reachable transition.
                 new_code, poison = s_code, True
-            sends = []
-            for c in out.commands:
-                assert isinstance(c, Send)
-                snd = Envelope(src=Id(i), dst=c.dst, msg=c.msg)
-                if snd.msg[0] == "put":
-                    raise CompileError(
-                        "mid-run put invocations are not compilable "
-                        "(put_count must be 1)"
-                    )
-                sc, ok = add_env(snd)
-                poison |= not ok
-                sends.append(sc)
-            trans[(i, s_code, e_code)] = (new_code, tuple(sends), poison)
+            sends, teff, poison = self._effects(i, out, add_env, poison)
+            trans[(i, s_code, e_code)] = (new_code, sends, poison, teff)
+
+        def process_timeout(i: int, s_code: int) -> None:
+            if (i, s_code) in ttrans:
+                return
+            s = self._states[i][s_code]
+            out = Out()
+            try:
+                ret = m.actors[i].on_timeout(Id(i), s, out)
+            except CompileError:
+                raise
+            except Exception:
+                ttrans[(i, s_code)] = (s_code, (), True, 0)
+                return
+            new_s = s if ret is None else ret
+            poison = False
+            new_code, ok = add_state(i, new_s)
+            if not ok:
+                new_code, poison = s_code, True
+            sends, teff, poison = self._effects(i, out, add_env, poison)
+            # flag cleared first; only an explicit SetTimer re-arms
+            ttrans[(i, s_code)] = (new_code, sends, poison, max(teff, 0))
 
         while work:
             item = work.popleft()
             if item[0] == "s":
                 _, i, s_code = item
+                process_timeout(i, s_code)
                 for e_code, env in enumerate(self._envs):
                     if int(env.dst) == i:
                         process(i, s_code, e_code)
@@ -384,29 +438,66 @@ class CompiledActorTensor(TensorModel):
                     for s_code in range(len(self._states[i])):
                         process(i, s_code, e_code)
 
+        # timers exist iff a timer can ever be SET: then (and only then)
+        # the encoding carries timer bits and step_rows emits Timeout
+        # actions — register workloads compile exactly as before
+        self._has_timers = any(init.is_timer_set) or any(
+            t[3] == 1 for t in trans.values()
+        ) or any(t[3] == 1 for t in ttrans.values())
+
         # -- freeze tables ---------------------------------------------------
         ne = len(self._envs)
         self.K = max(
-            (len(snds) for (_, snds, _) in trans.values()), default=0
+            (len(snds) for (_, snds, _, _) in trans.values()), default=0
+        )
+        self.Kt = max(
+            (len(snds) for (_, snds, _, _) in ttrans.values()), default=0
         )
         self._trans_np = []
         self._sends_np = []
         self._poison_np = []
+        self._teff_np = []
         for i in range(n):
             ns = len(self._states[i])
             ti = np.full((ns, ne), -1, np.int32)
             pi = np.zeros((ns, ne), bool)
             ki = np.full((ns, ne, max(self.K, 1)), -1, np.int32)
-            for (ai, sc, ec), (nc, snds, poison) in trans.items():
+            ei = np.full((ns, ne), -1, np.int32)
+            for (ai, sc, ec), (nc, snds, poison, teff) in trans.items():
                 if ai != i:
                     continue
                 ti[sc, ec] = nc
                 pi[sc, ec] = poison
+                ei[sc, ec] = teff
                 for k, s in enumerate(snds):
                     ki[sc, ec, k] = s
             self._trans_np.append(ti)
             self._sends_np.append(ki)
             self._poison_np.append(pi)
+            self._teff_np.append(ei)
+        # timeout tables: (i, s) -> successor code / sends / poison / new bit
+        self._ttrans_np = []
+        self._tsends_np = []
+        self._tpoison_np = []
+        self._tbit_np = []
+        for i in range(n):
+            ns = len(self._states[i])
+            ti = np.arange(ns, dtype=np.int32)  # default: state unchanged
+            pi = np.zeros(ns, bool)
+            bi = np.zeros(ns, np.int32)
+            ki = np.full((ns, max(self.Kt, 1)), -1, np.int32)
+            for (ai, sc), (nc, snds, poison, tbit) in ttrans.items():
+                if ai != i:
+                    continue
+                ti[sc] = nc
+                pi[sc] = poison
+                bi[sc] = tbit
+                for k, s in enumerate(snds):
+                    ki[sc, k] = s
+            self._ttrans_np.append(ti)
+            self._tsends_np.append(ki)
+            self._tpoison_np.append(pi)
+            self._tbit_np.append(bi)
 
         # per-envelope metadata
         self._env_dst = np.asarray(
@@ -421,18 +512,19 @@ class CompiledActorTensor(TensorModel):
         kinds = np.full(ne, _K_OTHER, np.int32)
         vals = np.zeros(ne, np.int32)
         chosen = np.zeros(ne, bool)
-        for c, e in enumerate(self._envs):
-            if e.msg[0] == "put_ok":
-                kinds[c] = _K_PUT_OK
-            elif e.msg[0] == "put_fail":
-                kinds[c] = _K_PUT_FAIL
-            elif e.msg[0] == "get_ok":
-                kinds[c] = _K_GET_OK
-                v = e.msg[2]
-                if self._wo and v == NULL_VALUE:
-                    v = None
-                vals[c] = self.hist._value_code(v)
-                chosen[c] = e.msg[2] != NULL_VALUE
+        if not self.general:  # register-workload history/property metadata
+            for c, e in enumerate(self._envs):
+                if e.msg[0] == "put_ok":
+                    kinds[c] = _K_PUT_OK
+                elif e.msg[0] == "put_fail":
+                    kinds[c] = _K_PUT_FAIL
+                elif e.msg[0] == "get_ok":
+                    kinds[c] = _K_GET_OK
+                    v = e.msg[2]
+                    if self._wo and v == NULL_VALUE:
+                        v = None
+                    vals[c] = self.hist._value_code(v)
+                    chosen[c] = e.msg[2] != NULL_VALUE
         self._env_kind = kinds
         self._env_val = vals
         self._env_chosen = chosen
@@ -443,6 +535,71 @@ class CompiledActorTensor(TensorModel):
             ],
             np.int32,
         )
+
+    def _effects(self, i: int, out: Out, add_env, poison: bool):
+        """Fold a handler's command list into (send codes, timer effect,
+        poison).  Timer commands apply sequentially — the last one wins —
+        mirroring ``_process_commands``; ``-1`` means no timer command."""
+        sends = []
+        teff = -1
+        for c in out.commands:
+            if isinstance(c, SetTimer):
+                teff = 1
+            elif isinstance(c, CancelTimer):
+                teff = 0
+            else:
+                assert isinstance(c, Send)
+                snd = Envelope(src=Id(i), dst=c.dst, msg=c.msg)
+                if not self.general and snd.msg[0] == "put":
+                    raise CompileError(
+                        "mid-run put invocations are not compilable "
+                        "(put_count must be 1)"
+                    )
+                sc, ok = add_env(snd)
+                poison |= not ok
+                sends.append(sc)
+        return tuple(sends), teff, poison
+
+    def _tabulate_properties(self) -> None:
+        """Freeze each factored property's predicate into per-actor (or
+        per-pair) boolean tables over the compiled state universes.  The
+        host evaluates the same predicate directly, so agreement is by
+        construction."""
+        self._prop_tables = []
+        n = self.n_actors
+        for p in self.model.properties():
+            f = p.condition  # a FactoredPredicate (checked in the fragment)
+            try:
+                if f.kind in ("forall", "exists"):
+                    tables = [
+                        np.asarray(
+                            [bool(f.pred(i, s)) for s in self._states[i]],
+                            bool,
+                        )
+                        for i in range(n)
+                    ]
+                else:
+                    tables = {
+                        (i, j): np.asarray(
+                            [
+                                [
+                                    bool(f.pred(i, si, j, sj))
+                                    for sj in self._states[j]
+                                ]
+                                for si in self._states[i]
+                            ],
+                            bool,
+                        )
+                        for i in range(n)
+                        for j in range(i + 1, n)
+                    }
+            except Exception as e:
+                raise CompileError(
+                    f"property {p.name!r}: predicate failed on an enumerated "
+                    f"state ({type(e).__name__}: {e}); factored predicates "
+                    "must be total over each actor's reachable states"
+                ) from e
+            self._prop_tables.append((f.kind, tables))
 
     # -- host bridge ---------------------------------------------------------
 
@@ -456,14 +613,19 @@ class CompiledActorTensor(TensorModel):
                     "(state_bound too tight, or a closure gap)"
                 )
             vals[f"a{i}"] = code
-        for c, (phase, snap, rval, wfail) in enumerate(
-            self.hist.fields_of_tester(st.history)
-        ):
-            vals[f"h{c}_phase"] = phase
-            vals[f"h{c}_snap"] = snap
-            vals[f"h{c}_rval"] = rval
-            if self.hist.wfail_bits:
-                vals[f"h{c}_wfail"] = wfail
+        if not self.general:
+            for c, (phase, snap, rval, wfail) in enumerate(
+                self.hist.fields_of_tester(st.history)
+            ):
+                vals[f"h{c}_phase"] = phase
+                vals[f"h{c}_snap"] = snap
+                vals[f"h{c}_rval"] = rval
+                if self.hist.wfail_bits:
+                    vals[f"h{c}_wfail"] = wfail
+        if self._has_timers:
+            vals["timers"] = sum(
+                1 << i for i, t in enumerate(st.is_timer_set) if t
+            )
         vals["poison"] = 0
         if self.ordered:
             # slot "count" = 1-based rank within the directed flow (1 = head)
@@ -488,16 +650,28 @@ class CompiledActorTensor(TensorModel):
         actors = tuple(
             self._states[i][d[f"a{i}"]] for i in range(self.n_actors)
         )
-        tester = self.hist.tester_of_fields(
-            [
-                (
-                    d[f"h{c}_phase"],
-                    d[f"h{c}_snap"],
-                    d[f"h{c}_rval"],
-                    d.get(f"h{c}_wfail", 0) if self.hist.wfail_bits else 0,
-                )
-                for c in range(self.C)
-            ]
+        if self.general:
+            tester = None
+        else:
+            tester = self.hist.tester_of_fields(
+                [
+                    (
+                        d[f"h{c}_phase"],
+                        d[f"h{c}_snap"],
+                        d[f"h{c}_rval"],
+                        d.get(f"h{c}_wfail", 0)
+                        if self.hist.wfail_bits
+                        else 0,
+                    )
+                    for c in range(self.C)
+                ]
+            )
+        timers = (
+            tuple(
+                bool((d["timers"] >> i) & 1) for i in range(self.n_actors)
+            )
+            if self._has_timers
+            else (False,) * self.n_actors
         )
         pairs = self.codec.unpack(row[self.pw :])
         if self.ordered:
@@ -523,7 +697,7 @@ class CompiledActorTensor(TensorModel):
         return ActorModelState(
             actor_states=actors,
             network=network,
-            is_timer_set=(False,) * self.n_actors,
+            is_timer_set=timers,
             history=tester,
         )
 
@@ -555,6 +729,24 @@ class CompiledActorTensor(TensorModel):
                 "env_val": jnp.asarray(self._env_val),
                 "env_chosen": jnp.asarray(self._env_chosen),
             }
+            if self._has_timers:
+                self._device_consts.update(
+                    teff=[jnp.asarray(t) for t in self._teff_np],
+                    ttrans=[jnp.asarray(t) for t in self._ttrans_np],
+                    tsends=[jnp.asarray(t) for t in self._tsends_np],
+                    tpoison=[jnp.asarray(t) for t in self._tpoison_np],
+                    tbit=[jnp.asarray(t) for t in self._tbit_np],
+                )
+            if self.general:
+                self._device_consts["props"] = [
+                    (
+                        kind,
+                        [jnp.asarray(t) for t in tables]
+                        if isinstance(tables, list)
+                        else {k: jnp.asarray(v) for k, v in tables.items()},
+                    )
+                    for kind, tables in self._prop_tables
+                ]
         return self._device_consts
 
     def step_rows(self, rows):
@@ -646,6 +838,20 @@ class CompiledActorTensor(TensorModel):
                 valid & occupied & (dst == i), new_scode, cur
             )
             out = pk.set(out, f"a{i}", v.astype(u64))
+        if self._has_timers:
+            # a deliver's handler may set/cancel the recipient's timer
+            timers_cur = pk.get(rows, "timers").astype(i32)  # [B]
+            tnew = jnp.broadcast_to(timers_cur[:, None], (B, NS))
+            for i in range(self.n_actors):
+                mask = valid & occupied & (dst == i)
+                sc = pk.get(rows, f"a{i}").astype(i32)[:, None]
+                eff = cst["teff"][i].reshape(-1)[sc * ne + ecode]  # [B, NS]
+                tnew = jnp.where(
+                    mask & (eff == 1),
+                    tnew | (1 << i),
+                    jnp.where(mask & (eff == 0), tnew & ~(1 << i), tnew),
+                )
+            out = pk.set(out, "timers", tnew.astype(u64))
 
         # -- history updates -------------------------------------------------
         if self.C:
@@ -719,7 +925,7 @@ class CompiledActorTensor(TensorModel):
         succ = jnp.concatenate([out[:, :, : self.pw], slots_d], axis=-1)
 
         if not self.model.lossy:
-            return succ, valid
+            return self._append_timeouts(rows, slots, cst, succ, valid)
 
         # -- drop actions (lossy networks): consume without delivering ------
         if self.ordered:
@@ -755,7 +961,73 @@ class CompiledActorTensor(TensorModel):
         succ = jnp.concatenate([succ, drop_rows], axis=1)
         droppable = at_head if self.ordered else occupied
         valid = jnp.concatenate([valid, droppable], axis=1)
-        return succ, valid
+        return self._append_timeouts(rows, slots, cst, succ, valid)
+
+    def _append_timeouts(self, rows, slots, cst, succ, valid):
+        """Append one Timeout action column per actor (reference
+        ``model.rs:234-238,288-306``): valid iff the actor's timer bit is
+        set; the tabulated ``on_timeout`` effect updates the actor state,
+        appends its sends, and rewrites the timer bit (cleared unless the
+        handler re-armed it)."""
+        if not self._has_timers:
+            return succ, valid
+        import jax.numpy as jnp
+
+        i32, u64 = jnp.int32, jnp.uint64
+        pk = self.pk
+        B = rows.shape[0]
+        n = self.n_actors
+        NS = self.n_slots
+        timers_cur = pk.get(rows, "timers").astype(i32)  # [B]
+        col = jnp.arange(n, dtype=i32)[None, :]  # [1, n]
+        out_t = jnp.broadcast_to(rows[:, None, :], (B, n, self.width))
+        valid_t = ((timers_cur[:, None] >> col) & 1) == 1  # [B, n]
+        poison_t = jnp.zeros((B, n), bool)
+        tvals = []
+        send_cols = []
+        for i in range(n):
+            sc = pk.get(rows, f"a{i}").astype(i32)  # [B]
+            nc = cst["ttrans"][i][sc]
+            pi = cst["tpoison"][i][sc]
+            nb = cst["tbit"][i][sc]
+            send_cols.append(cst["tsends"][i][sc])  # [B, Kt]
+            out_t = pk.set(
+                out_t,
+                f"a{i}",
+                jnp.where(col == i, nc[:, None], sc[:, None]).astype(u64),
+            )
+            tvals.append((timers_cur & ~(1 << i)) | (nb << i))
+            poison_t = poison_t | ((col == i) & pi[:, None])
+        out_t = pk.set(out_t, "timers", jnp.stack(tvals, 1).astype(u64))
+        slots_t = jnp.broadcast_to(slots[:, None, :], (B, n, NS))
+        sk_all = jnp.stack(send_cols, axis=1)  # [B, n, Kt]
+        for k in range(self.Kt):
+            sk = sk_all[..., k]
+            if self.ordered:
+                slots_t, of = slot_send_ordered(
+                    slots_t, sk.astype(u64), cst["env_pair"],
+                    valid_t & (sk >= 0),
+                )
+            else:
+                slots_t, of = slot_send(
+                    slots_t, sk.astype(u64), valid_t & (sk >= 0),
+                    set_semantics=self.dup,
+                )
+            poison_t = poison_t | of
+        cur_poison = pk.get(rows, "poison").astype(i32)[:, None]
+        out_t = pk.set(
+            out_t,
+            "poison",
+            jnp.maximum(
+                jnp.where(poison_t, 1, 0), cur_poison
+            ).astype(u64),
+        )
+        slots_t = slot_canonicalize(slots_t)
+        succ_t = jnp.concatenate([out_t[:, :, : self.pw], slots_t], axis=-1)
+        return (
+            jnp.concatenate([succ, succ_t], axis=1),
+            jnp.concatenate([valid, valid_t], axis=1),
+        )
 
     def _client_of_dev(self):
         import jax.numpy as jnp
@@ -768,6 +1040,29 @@ class CompiledActorTensor(TensorModel):
         cst = self._consts()
         i32, u64 = jnp.int32, jnp.uint64
         pk = self.pk
+
+        if self.general:
+            n = self.n_actors
+            codes = [
+                pk.get(rows, f"a{i}").astype(i32) for i in range(n)
+            ]
+            B = rows.shape[0]
+            masks = []
+            for kind, tables in cst["props"]:
+                if kind in ("forall", "exists"):
+                    per = [tables[i][codes[i]] for i in range(n)]
+                    v = per[0]
+                    for x in per[1:]:
+                        v = (v & x) if kind == "forall" else (v | x)
+                else:
+                    conj = kind == "forall_pairs"
+                    v = jnp.full((B,), conj, bool)
+                    for i in range(n):
+                        for j in range(i + 1, n):
+                            x = tables[(i, j)][codes[i], codes[j]]
+                            v = (v & x) if conj else (v | x)
+                masks.append(v)
+            return jnp.stack(masks, axis=-1)
 
         phases = jnp.stack(
             [pk.get(rows, f"h{c}_phase").astype(i32) for c in range(self.C)],

@@ -1,0 +1,106 @@
+"""Raft leader election — the actor compiler's GENERAL fragment.
+
+Beyond the reference's example set.  Pins: the host state space, election
+safety (always) + leader-elected witness (sometimes), and full
+device/host parity for a timeout-driven, history-free actor system whose
+twin is compiled mechanically (timer bits, Timeout actions, factored
+property tables — ``parallel/actor_compiler.py`` general mode).
+"""
+
+import pytest
+
+from stateright_tpu.actor import ActorModel, Network
+from stateright_tpu.actor.device_props import exists_actor
+from stateright_tpu.core import Expectation
+from stateright_tpu.models.raft import LEADER, RaftServer, raft_model
+
+RAFT3_UNIQUE = 5_725  # 3 servers, max_term=2, unordered non-duplicating
+
+
+def test_raft3_host_pinned_count_and_properties():
+    c = raft_model(3).checker().spawn_bfs().join()
+    assert c.unique_state_count() == RAFT3_UNIQUE
+    # election safety holds (no counterexample); a leader is reachable
+    assert sorted(c.discoveries()) == ["a leader is elected"]
+    c.assert_properties()
+
+
+def test_raft3_twin_crawl_equivalence():
+    """Per-level successor/fingerprint/property parity of the compiled
+    twin, incl. Timeout actions and timer-bit round-trips."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_paxos_tensor import crawl_and_check
+
+    m = raft_model(3)
+    tm = m.tensor_model()
+    assert tm is not None and tm._has_timers
+    crawl_and_check(m, tm, max_levels=4)
+
+
+def test_raft3_engine_full_parity():
+    """Full-space device enumeration matches the host oracle, and the
+    leader-election witness re-executes."""
+    m = raft_model(3)
+    c = m.checker().spawn_tpu(
+        sync=True, capacity=1 << 15, frontier_capacity=1 << 9
+    )
+    assert c.unique_state_count() == RAFT3_UNIQUE
+    assert sorted(c.discoveries()) == ["a leader is elected"]
+    path = c.discoveries()["a leader is elected"]
+    c.assert_discovery("a leader is elected", list(path.actions()))
+    assert path.final_state().actor_states[int(path.actions()[-1].dst)].role == LEADER
+
+
+@pytest.mark.medium
+def test_raft3_lossy_engine_parity():
+    """Message loss adds Drop actions; host and device agree on the
+    enlarged space and still find a leader (drops are optional)."""
+    m = raft_model(3)
+    m.lossy_network(True)
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(
+        sync=True, capacity=1 << 16, frontier_capacity=1 << 10
+    )
+    assert h.unique_state_count() == c.unique_state_count()
+    assert sorted(h.discoveries()) == sorted(c.discoveries())
+
+
+def test_raft2_no_split_brain_two_servers():
+    """With 2 servers a majority is 2: no term can elect two leaders, and
+    the safety property discovers nothing on host or device."""
+    m = raft_model(2)
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 13)
+    assert h.unique_state_count() == c.unique_state_count()
+    assert "election safety" not in h.discoveries()
+    assert "election safety" not in c.discoveries()
+
+
+def test_history_free_model_requires_factored_properties():
+    from stateright_tpu.parallel.actor_compiler import (
+        CompileError,
+        compile_actor_model,
+    )
+
+    m = ActorModel(cfg=None, init_history=None)
+    m.actor(RaftServer(peers=[], cluster=1, max_term=1))
+    m.init_network_(Network.new_unordered_nonduplicating())
+    m.property(
+        Expectation.ALWAYS, "opaque", lambda model, s: True  # not factored
+    )
+    with pytest.raises(CompileError, match="factored"):
+        compile_actor_model(m)
+
+
+def test_factored_predicates_evaluate_on_host():
+    """The same predicate object drives host checking directly."""
+    m = raft_model(3)
+    checker = m.checker().spawn_dfs().join()
+    assert checker.unique_state_count() == RAFT3_UNIQUE
+    # exists_actor works as a plain condition
+    cond = exists_actor(lambda i, s: s.role == LEADER)
+    final = checker.discoveries()["a leader is elected"].final_state()
+    assert cond(m, final)
