@@ -11,6 +11,8 @@ reference's argument shapes (e.g. ``examples/paxos.rs:314-395``).
 | single_copy_register | unreplicated register (violation demo) | 93 @ 1 server; 20 @ 2 servers |
 | increment | racy shared counter | 13 / 8 with symmetry (2 threads) |
 | increment_lock | counter with lock | mutex + fin hold |
+| raft | Raft leader election (beyond the reference; compiled general fragment) | 5,725 @ 3 servers / 2 terms |
+| quickstart | sliding puzzle, Lamport + vector clocks | doctest-scale |
 """
 
 __all__ = [
@@ -20,4 +22,6 @@ __all__ = [
     "single_copy_register",
     "increment",
     "increment_lock",
+    "raft",
+    "quickstart",
 ]
