@@ -68,6 +68,18 @@ def test_raft3_lossy_engine_parity():
     assert sorted(h.discoveries()) == sorted(c.discoveries())
 
 
+@pytest.mark.parametrize("net", ["ordered", "unordered_duplicating"])
+def test_raft2_engine_parity_across_network_semantics(net):
+    """Timer-fragment compilation composes with every network semantics:
+    host and device enumerate the same space under ordered FIFO and
+    duplicating redelivery too."""
+    m = raft_model(2, network=Network.from_name(net))
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 13)
+    assert h.unique_state_count() == c.unique_state_count() > 0
+    assert sorted(h.discoveries()) == sorted(c.discoveries())
+
+
 def test_raft2_no_split_brain_two_servers():
     """With 2 servers a majority is 2: no term can elect two leaders, and
     the safety property discovers nothing on host or device."""
