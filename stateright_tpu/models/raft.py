@@ -44,7 +44,9 @@ class RaftState:
     role: int = FOLLOWER
     term: int = 0
     voted_for: int = -1  # candidate id this server voted for in `term`
-    votes: int = 0  # bitmask of granters (candidates only)
+    #: granter ids (candidates only); a frozenset rather than a bitmask so
+    #: runtime sockaddr ids (~2^47) work as well as dense model ids
+    votes: frozenset = frozenset()
 
 
 class RaftServer(Actor):
@@ -55,13 +57,22 @@ class RaftServer(Actor):
     majority becomes leader and stops campaigning.
     """
 
-    def __init__(self, peers: list[Id], cluster: int, max_term: int):
+    def __init__(
+        self,
+        peers: list[Id],
+        cluster: int,
+        max_term: int,
+        timer_range=(0.0, 0.0),
+    ):
         self.peers = peers
         self.cluster = cluster
         self.max_term = max_term
+        # model checking ignores durations (any set timer may fire); a real
+        # deployment passes Raft's randomized election timeout here
+        self.timer_range = timer_range
 
     def on_start(self, id: Id, out: Out):
-        out.set_timer()  # election timer
+        out.set_timer(self.timer_range)  # election timer
         return RaftState()
 
     def on_timeout(self, id: Id, state: RaftState, out: Out):
@@ -69,12 +80,12 @@ class RaftServer(Actor):
             return None  # stop campaigning (timer stays cleared)
         term = state.term + 1
         out.broadcast(self.peers, ("req_vote", term))
-        out.set_timer()  # elections may time out and retry
+        out.set_timer(self.timer_range)  # elections may time out and retry
         return RaftState(
             role=CANDIDATE,
             term=term,
             voted_for=int(id),
-            votes=1 << int(id),
+            votes=frozenset((int(id),)),
         )
 
     def on_msg(self, id: Id, state: RaftState, src: Id, msg, out: Out):
@@ -97,12 +108,12 @@ class RaftServer(Actor):
         if kind == "grant":
             if state.role != CANDIDATE or term != state.term:
                 return None  # stale grant
-            votes = state.votes | (1 << int(src))
-            if votes == state.votes:
+            if int(src) in state.votes:
                 return None  # duplicate grant
+            votes = state.votes | {int(src)}
             role = (
                 LEADER
-                if bin(votes).count("1") >= majority(self.cluster)
+                if len(votes) >= majority(self.cluster)
                 else CANDIDATE
             )
             return RaftState(

@@ -194,3 +194,46 @@ def test_orl_resends_until_ack_after_injected_drop():
         for h in s_handles:
             h.stop()
             h.join(2.0)
+
+
+def test_raft_leader_election_over_udp():
+    """The SAME RaftServer actor that was model checked (tests/test_raft.py)
+    deployed on real loopback sockets with Raft's randomized election
+    timeouts: three servers elect a leader through genuine UDP exchange and
+    real timer fires, and election safety holds over the observed states
+    (one leader per term) — the reference's model-then-deploy story
+    (``spawn.rs:63-140``) exercised with timers."""
+    from stateright_tpu.models.raft import LEADER, RaftServer
+
+    ports = [free_port() for _ in range(3)]
+    ids = [Id.from_addr("127.0.0.1", p) for p in ports]
+    handles = spawn(
+        [
+            (
+                ids[i],
+                RaftServer(
+                    peers=[x for x in ids if x != ids[i]],
+                    cluster=3,
+                    max_term=50,
+                    timer_range=(0.02, 0.12),
+                ),
+            )
+            for i in range(3)
+        ]
+    )
+    try:
+        assert wait_until(
+            lambda: any(
+                h.state is not None and h.state.role == LEADER
+                for h in handles
+            ),
+            timeout=15.0,
+        ), [h.state for h in handles]
+        states = [h.state for h in handles if h.state is not None]
+        leaders_by_term = [s.term for s in states if s.role == LEADER]
+        assert len(leaders_by_term) == len(set(leaders_by_term)), states
+    finally:
+        for h in handles:
+            h.stop()
+        for h in handles:
+            h.join(timeout=2.0)
