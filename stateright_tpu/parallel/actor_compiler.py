@@ -175,6 +175,7 @@ class CompiledActorTensor(TensorModel):
         self._closure()
         if self.general:
             self._tabulate_properties()
+        self._tabulate_boundary()
 
         self.n_slots = n_slots if n_slots is not None else max(
             16, 4 * self.n_actors
@@ -225,8 +226,23 @@ class CompiledActorTensor(TensorModel):
             )
         self.dup = isinstance(m.init_network, UnorderedDuplicatingNetwork)
         self.ordered = isinstance(m.init_network, OrderedNetwork)
+        from ..actor.device_props import FactoredPredicate as _FP
+
+        self._boundary = None
         if m._within_boundary is not _default_boundary:
-            raise CompileError("custom within_boundary is not compilable")
+            # a FACTORED boundary compiles (tabulated like the properties;
+            # successors crossing it are masked invalid, mirroring the host
+            # checkers' within_boundary filter); arbitrary closures do not
+            if isinstance(m._within_boundary, _FP) and m._within_boundary.kind in (
+                "forall",
+                "exists",
+            ):
+                self._boundary = m._within_boundary
+            else:
+                raise CompileError(
+                    "within_boundary must be a factored per-actor predicate "
+                    "(forall_actors/exists_actor) to compile"
+                )
         if m.init_history is None:
             # GENERAL fragment: no auxiliary history; every property must be
             # a factored predicate the compiler can tabulate over the
@@ -601,6 +617,32 @@ class CompiledActorTensor(TensorModel):
                 ) from e
             self._prop_tables.append((f.kind, tables))
 
+    def _tabulate_boundary(self) -> None:
+        """Freeze a factored ``within_boundary`` into per-actor tables; the
+        engines' successor mask then mirrors the host checkers' boundary
+        filter exactly."""
+        if self._boundary is None:
+            self._boundary_np = None
+            return
+        f = self._boundary
+        try:
+            self._boundary_np = [
+                np.asarray(
+                    [bool(f.pred(i, s)) for s in self._states[i]], bool
+                )
+                for i in range(self.n_actors)
+            ]
+        except Exception as e:
+            raise CompileError(
+                f"within_boundary predicate failed on an enumerated state "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        if not f(self.model, self._init_state):
+            raise CompileError(
+                "the initial state is outside within_boundary: the host "
+                "checkers would explore nothing; fix the boundary"
+            )
+
     # -- host bridge ---------------------------------------------------------
 
     def encode_state(self, st: ActorModelState) -> tuple:
@@ -737,6 +779,10 @@ class CompiledActorTensor(TensorModel):
                     tpoison=[jnp.asarray(t) for t in self._tpoison_np],
                     tbit=[jnp.asarray(t) for t in self._tbit_np],
                 )
+            if self._boundary_np is not None:
+                self._device_consts["boundary"] = [
+                    jnp.asarray(t) for t in self._boundary_np
+                ]
             if self.general:
                 self._device_consts["props"] = [
                     (
@@ -1028,6 +1074,31 @@ class CompiledActorTensor(TensorModel):
             jnp.concatenate([succ, succ_t], axis=1),
             jnp.concatenate([valid, valid_t], axis=1),
         )
+
+    @property
+    def has_boundary(self) -> bool:
+        return self._boundary_np is not None
+
+    def boundary_rows(self, rows):
+        """``within_boundary`` over encoded rows (the device analogue of the
+        host checkers' boundary filter; ``step_rows`` itself mirrors the
+        UNfiltered ``next_states``, exactly like the object form).  Present
+        only when the model declares a factored boundary — the engines
+        check for this method and mask out-of-boundary successors."""
+        import jax.numpy as jnp
+
+        cst = self._consts()
+        i32 = jnp.int32
+        per = [
+            cst["boundary"][i][
+                self.pk.get(rows, f"a{i}").astype(i32)
+            ]
+            for i in range(self.n_actors)
+        ]
+        b = per[0]
+        for x in per[1:]:
+            b = (b & x) if self._boundary.kind == "forall" else (b | x)
+        return b
 
     def _client_of_dev(self):
         import jax.numpy as jnp

@@ -117,6 +117,11 @@ def _build_sharded_run(
 
     init_rows_np = np.asarray(tensor.init_rows(), dtype=np.uint64)
     n_init = init_rows_np.shape[0]
+    boundary_fn = (
+        tensor.boundary_rows
+        if getattr(tensor, "has_boundary", False)
+        else None
+    )
     m_cand = fcap_local * arity
     if cand_local is not None:
         cand_local = min(cand_local, ndev * bucket_cap)
@@ -284,6 +289,9 @@ def _build_sharded_run(
             elive = live & ~all_discovered(disc)
 
             succ, valid = tensor.step_rows(rows)  # [F, A, W], [F, A]
+            if boundary_fn is not None:
+                # host-checker parity: boundary filter before counting
+                valid = valid & boundary_fn(succ)
             valid = valid & elive[:, None]
             scount = scount + jax.lax.psum(jnp.sum(valid, dtype=jnp.int64), AXIS)
             terminal = elive & ~jnp.any(valid, axis=-1)

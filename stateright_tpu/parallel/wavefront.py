@@ -167,6 +167,12 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             return jnp.bool_(False)
         return jnp.all(disc != jnp.uint64(0))
 
+    boundary_fn = (
+        tensor.boundary_rows
+        if getattr(tensor, "has_boundary", False)
+        else None
+    )
+
     def step(carry):
         """Pop one batch, expand, dedup+insert, append novel rows."""
         (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
@@ -187,6 +193,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         elive = live & ~all_discovered(disc)
 
         succ, valid = tensor.step_rows(rows)  # [B, A, W], [B, A]
+        if boundary_fn is not None:
+            # mirror the host checkers: out-of-boundary successors are
+            # neither counted nor enqueued, and a state whose successors
+            # all fall outside IS terminal for ebits flushing
+            valid = valid & boundary_fn(succ)
         valid = valid & elive[:, None]
         terminal = elive & ~jnp.any(valid, axis=-1)
         disc = flush_terminal(terminal, fps, ebits, disc)

@@ -91,6 +91,21 @@ def test_raft2_no_split_brain_two_servers():
     assert "election safety" not in c.discoveries()
 
 
+def test_factored_within_boundary_compiles_and_agrees():
+    """A factored ``within_boundary`` compiles: the device engine masks
+    out-of-boundary successors exactly like the host checkers (boundary
+    filter before counting; fully-masked states are terminal)."""
+    from stateright_tpu.actor.device_props import forall_actors
+
+    m = raft_model(3)
+    m.within_boundary_(forall_actors(lambda i, s: s.term <= 1))
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 13)
+    assert h.unique_state_count() == c.unique_state_count()
+    assert 0 < h.unique_state_count() < RAFT3_UNIQUE
+    assert sorted(h.discoveries()) == sorted(c.discoveries())
+
+
 def test_history_free_model_requires_factored_properties():
     from stateright_tpu.parallel.actor_compiler import (
         CompileError,
