@@ -106,6 +106,28 @@ def test_factored_within_boundary_compiles_and_agrees():
     assert sorted(h.discoveries()) == sorted(c.discoveries())
 
 
+def test_eventually_property_parity_general_fragment():
+    """Liveness bookkeeping (ebits) composes with the general fragment:
+    with a single term two servers can split their votes and stop
+    campaigning, a terminal path electing nobody — host and device both
+    discover the 'eventually' counterexample on the same space."""
+    m = raft_model(2, max_term=1)
+    m.property(
+        Expectation.EVENTUALLY,
+        "eventually elects",
+        exists_actor(lambda i, s: s.role == LEADER),
+    )
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 13)
+    assert h.unique_state_count() == c.unique_state_count() == 25
+    assert "eventually elects" in h.discoveries()
+    assert "eventually elects" in c.discoveries()
+    # the counterexample ends terminal with no leader (reference ebits
+    # semantics: bits still set at a terminal state flush as discoveries)
+    final = h.discoveries()["eventually elects"].final_state()
+    assert all(s.role != LEADER for s in final.actor_states)
+
+
 def test_history_free_model_requires_factored_properties():
     from stateright_tpu.parallel.actor_compiler import (
         CompileError,
