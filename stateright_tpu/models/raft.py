@@ -43,8 +43,11 @@ FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 class RaftState:
     role: int = FOLLOWER
     term: int = 0
-    voted_for: int = -1  # candidate id this server voted for in `term`
-    #: granter ids (candidates only); a frozenset rather than a bitmask so
+    #: candidate Id this server voted for in `term` (-1: none).  Stored as
+    #: Id (not int) so symmetry reduction rewrites them under actor
+    #: permutations, on host and in the compiled twin's tables alike
+    voted_for: int = -1
+    #: granter Ids (candidates only); a frozenset rather than a bitmask so
     #: runtime sockaddr ids (~2^47) work as well as dense model ids
     votes: frozenset = frozenset()
 
@@ -84,8 +87,8 @@ class RaftServer(Actor):
         return RaftState(
             role=CANDIDATE,
             term=term,
-            voted_for=int(id),
-            votes=frozenset((int(id),)),
+            voted_for=Id(id),
+            votes=frozenset((Id(id),)),
         )
 
     def on_msg(self, id: Id, state: RaftState, src: Id, msg, out: Out):
@@ -94,7 +97,7 @@ class RaftServer(Actor):
             if term > state.term:
                 # newer term: step down and grant
                 out.send(src, ("grant", term))
-                return RaftState(term=term, voted_for=int(src))
+                return RaftState(term=term, voted_for=Id(src))
             if (
                 term == state.term
                 and state.role == FOLLOWER
@@ -103,14 +106,14 @@ class RaftServer(Actor):
                 out.send(src, ("grant", term))
                 if state.voted_for == int(src):
                     return None  # duplicate request, vote already recorded
-                return RaftState(term=term, voted_for=int(src))
+                return RaftState(term=term, voted_for=Id(src))
             return None  # stale or already voted: ignore
         if kind == "grant":
             if state.role != CANDIDATE or term != state.term:
                 return None  # stale grant
             if int(src) in state.votes:
                 return None  # duplicate grant
-            votes = state.votes | {int(src)}
+            votes = state.votes | {Id(src)}
             role = (
                 LEADER
                 if len(votes) >= majority(self.cluster)

@@ -253,7 +253,13 @@ class WavefrontChecker(Checker):
     def _symmetry_key(self):
         if self._symmetry is None:
             return None
-        # device traces record canonical fingerprints; match classes
+        # device traces record canonical fingerprints; match classes.  A
+        # twin may provide its own host-side key (the mechanical symmetry
+        # of compiled models hashes a virtual canonical row rather than an
+        # encodable representative state)
+        tkey = getattr(self.tensor, "representative_key", None)
+        if tkey is not None:
+            return tkey
         sym, model = self._symmetry, self.model
         return lambda s: model.fingerprint_state(sym(s))
 

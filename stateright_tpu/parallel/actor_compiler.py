@@ -176,6 +176,9 @@ class CompiledActorTensor(TensorModel):
         if self.general:
             self._tabulate_properties()
         self._tabulate_boundary()
+        self._sym_tables = None
+        if self.general:
+            self._try_build_symmetry()
 
         self.n_slots = n_slots if n_slots is not None else max(
             16, 4 * self.n_actors
@@ -643,6 +646,186 @@ class CompiledActorTensor(TensorModel):
                 "checkers would explore nothing; fix the boundary"
             )
 
+    # -- mechanical device symmetry (general fragment) -----------------------
+
+    _SYM_MAX_PERMS = 720  # n! cap: tables are [n!, |universe|]
+
+    def _try_build_symmetry(self) -> None:
+        """Mechanical symmetry reduction for compiled models whose actors
+        share ONE state universe (fully interchangeable actors, e.g. Raft
+        servers).  Mirrors the host ``ActorModelState.representative``
+        exactly: the permutation is the stable sort of per-actor state
+        ``stable_hash`` keys, and states/envelopes are rewritten through
+        the real ``rewrite_value`` — tabulated per permutation, so the
+        device canonicalizes a whole wavefront with gathers.  The
+        canonical output is a *virtual* row (universe codes + permuted
+        timer word + remapped slots) used only for hashing; rewritten
+        values outside the reachable universe are interned for coding.
+        On success the instance gains ``representative_rows`` (device) and
+        ``representative_key`` (host), and ``.symmetry()`` works on the
+        device engines with zero user code."""
+        import math
+        from itertools import permutations
+
+        from ..fingerprint import stable_hash
+        from ..symmetry import RewritePlan, rewrite_value
+
+        n = self.n_actors
+        if n < 2 or math.factorial(n) > self._SYM_MAX_PERMS:
+            return
+        # the UNION of per-actor universes: symmetric systems reach
+        # per-actor value sets that are permuted images of each other, so
+        # canonical codes live in the union (virtual rows are never
+        # decoded, only hashed)
+        universe: list = []
+        ucode: dict = {}
+
+        def intern(v) -> int:
+            c = ucode.get(v)
+            if c is None:
+                c = len(universe)
+                universe.append(v)
+                ucode[v] = c
+            return c
+
+        for i in range(n):
+            for s in self._states[i]:
+                intern(s)
+        real_u = len(universe)
+
+        umaps = [
+            np.asarray([ucode[s] for s in self._states[i]], np.int32)
+            for i in range(n)
+        ]
+        perms = list(permutations(range(n)))  # lexicographic mapping order
+        rw = np.zeros((len(perms), real_u), np.int32)
+        ev = np.zeros((len(perms), max(1, len(self._envs))), np.int32)
+        env_intern: dict = dict(self._env_code)
+
+        def env_code_of(e: Envelope) -> int:
+            c = env_intern.get(e)
+            if c is None:
+                c = len(env_intern)
+                env_intern[e] = c
+            return c
+
+        try:
+            for pi, mapping in enumerate(perms):
+                plan = RewritePlan(list(mapping))
+                for u in range(real_u):
+                    rw[pi, u] = intern(rewrite_value(universe[u], plan))
+                for ec, e in enumerate(self._envs):
+                    ev[pi, ec] = env_code_of(
+                        Envelope(
+                            src=plan.rewrite_id(e.src),
+                            dst=plan.rewrite_id(e.dst),
+                            msg=rewrite_value(e.msg, plan),
+                        )
+                    )
+        except Exception:
+            return  # a state/msg resists rewriting: no mechanical symmetry
+        self._sym_tables = {
+            "umaps": umaps,
+            "keys": np.asarray(
+                [np.uint64(stable_hash(v)) for v in universe[:real_u]],
+                np.uint64,
+            ),
+            "rw": rw,
+            "ev": ev,
+            "fact": [math.factorial(n - 1 - k) for k in range(n)],
+        }
+        self.representative_rows = self._representative_rows_impl
+        self.representative_key = self._representative_key_impl
+
+    def _sym_consts(self):
+        import jax.numpy as jnp
+
+        c = self.__dict__.get("_sym_dev")
+        if c is None:
+            t = self._sym_tables
+            c = {
+                "umaps": [jnp.asarray(u) for u in t["umaps"]],
+                "keys": jnp.asarray(t["keys"]),
+                "rw": jnp.asarray(t["rw"]),
+                "ev": jnp.asarray(t["ev"]),
+            }
+            self._sym_dev = c
+        return c
+
+    def _representative_rows_impl(self, rows):
+        """Canonical VIRTUAL rows (for hashing only): ``[..., n + 1 + NS]``
+        u64 — universe codes of the plan-rewritten sorted actor states,
+        the permuted timer word, and the envelope-remapped sorted slots.
+        Accepts any leading shape (engines pass ``[B, A, W]``)."""
+        import jax.numpy as jnp
+
+        cst = self._sym_consts()
+        i32, u64 = jnp.int32, jnp.uint64
+        pk = self.pk
+        n = self.n_actors
+        fact = self._sym_tables["fact"]
+        ar = jnp.arange(n, dtype=i32)
+
+        ucodes = jnp.stack(
+            [
+                cst["umaps"][i][pk.get(rows, f"a{i}").astype(i32)]
+                for i in range(n)
+            ],
+            axis=-1,
+        )  # [..., n]
+        keys = cst["keys"][ucodes]
+        order = jnp.argsort(keys, axis=-1, stable=True)  # new -> old
+        mapping = jnp.argsort(order, axis=-1)  # old -> new (plan.mapping)
+        # lexicographic rank of the mapping tuple = table permutation index
+        lead = ucodes.shape[:-1]
+        perm_id = jnp.zeros(lead, i32)
+        for k in range(n):
+            c = jnp.zeros(lead, i32)
+            for j in range(k + 1, n):
+                c = c + (mapping[..., j] < mapping[..., k]).astype(i32)
+            perm_id = perm_id + c * jnp.int32(fact[k])
+
+        usorted = jnp.take_along_axis(ucodes, order, axis=-1)  # [..., n]
+        codes2 = cst["rw"][perm_id[..., None], usorted]  # [..., n]
+
+        if self._has_timers:
+            tb = pk.get(rows, "timers").astype(i32)  # [...]
+            bits = (tb[..., None] >> ar) & 1
+            bits = jnp.take_along_axis(bits, order, axis=-1)
+            tword = jnp.sum(bits << ar, axis=-1)
+        else:
+            tword = jnp.zeros(lead, i32)
+
+        slots = rows[..., self.pw :]
+        occ = slots != u64(SLOT_EMPTY)
+        e = jnp.where(occ, (slots >> u64(COUNT_BITS)).astype(i32), 0)
+        cnt = slots & u64(COUNT_MASK)
+        e2 = cst["ev"][perm_id[..., None], e]
+        slot2 = jnp.where(
+            occ,
+            (e2.astype(u64) << u64(COUNT_BITS)) | cnt,
+            u64(SLOT_EMPTY),
+        )
+        slot2 = slot_canonicalize(slot2)
+        return jnp.concatenate(
+            [
+                codes2.astype(u64),
+                tword[..., None].astype(u64),
+                slot2,
+            ],
+            axis=-1,
+        )
+
+    def _representative_key_impl(self, state: ActorModelState) -> int:
+        """Host-side symmetry key: the fingerprint the device stores for
+        ``state``'s class (used by trace reconstruction to match steps)."""
+        import numpy as np_
+
+        from ..ops import row_hash
+
+        row = np_.asarray([self.encode_state(state)], np_.uint64)
+        return int(np_.asarray(row_hash(self._representative_rows_impl(row)))[0])
+
     # -- host bridge ---------------------------------------------------------
 
     def encode_state(self, st: ActorModelState) -> tuple:
@@ -753,6 +936,8 @@ class CompiledActorTensor(TensorModel):
         # (CPU checkers fingerprinting via the twin) never call init_rows
         # and stay numpy-only.
         self._consts()
+        if self._sym_tables is not None:
+            self._sym_consts()  # same outside-any-trace rule as _consts
         return np.asarray([self.encode_state(self._init_state)], np.uint64)
 
     # -- device --------------------------------------------------------------
