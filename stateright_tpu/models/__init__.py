@@ -12,6 +12,7 @@ reference's argument shapes (e.g. ``examples/paxos.rs:314-395``).
 | increment | racy shared counter | 13 / 8 with symmetry (2 threads) |
 | increment_lock | counter with lock | mutex + fin hold |
 | raft | Raft leader election (beyond the reference; compiled general fragment) | 5,725 @ 3 servers / 2 terms |
+| dining | dining philosophers; deadlock found as a liveness counterexample | 359 @ 3 (full space) |
 | quickstart | sliding puzzle, Lamport + vector clocks | doctest-scale |
 """
 
@@ -23,5 +24,6 @@ __all__ = [
     "increment",
     "increment_lock",
     "raft",
+    "dining",
     "quickstart",
 ]

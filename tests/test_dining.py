@@ -1,0 +1,67 @@
+"""Dining philosophers — deadlock detection via the general fragment.
+
+Pins: the deadlock is discovered as an ``eventually`` counterexample
+whose trace ends in the circular wait (all philosophers holding their
+left fork); host and device enumerate the same full space when no
+early-exit applies; and the early-exit semantics itself (the reference's
+all-properties-discovered stop, ``bfs.rs:121-128``) kicks in on both.
+"""
+
+from stateright_tpu.actor.device_props import forall_actors
+from stateright_tpu.core import Expectation
+from stateright_tpu.models.dining import HAS_LEFT, dining_model
+
+DINING3_FULL = 359  # 3 philosophers + 3 forks, full space
+
+
+def _no_early_exit(m):
+    """An always-true ALWAYS property is never discovered, so the
+    all-properties-discovered early exit can't fire and both sides must
+    enumerate the full space."""
+    m.property(
+        Expectation.ALWAYS, "no early exit", forall_actors(lambda i, s: True)
+    )
+    return m
+
+
+def test_dining3_full_space_parity():
+    m = _no_early_exit(dining_model(3))
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 14)
+    assert h.unique_state_count() == c.unique_state_count() == DINING3_FULL
+    assert sorted(h.discoveries()) == sorted(c.discoveries()) == [
+        "everyone eats",
+        "someone eats",
+    ]
+
+
+def test_dining3_deadlock_trace():
+    """The eventually-counterexample ends in the classic circular wait:
+    every philosopher holds exactly their left fork."""
+    m = dining_model(3)
+    h = m.checker().spawn_bfs().join()
+    trace = h.discoveries()["everyone eats"]
+    h.assert_discovery("everyone eats", list(trace.actions()))
+    final = h.discoveries()["everyone eats"].final_state()
+    phils = final.actor_states[:3]
+    forks = final.actor_states[3:]
+    assert all(p.phase == HAS_LEFT for p in phils)
+    assert all(f.holder != -1 and f.pending for f in forks)
+    # terminal: nothing in flight, nothing deliverable
+    assert m.next_steps(final) == []
+
+
+def test_dining3_device_finds_deadlock():
+    m = dining_model(3)
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 14)
+    assert "everyone eats" in c.discoveries()  # the deadlock counterexample
+    assert "someone eats" in c.discoveries()  # and a successful dinner
+    final = c.discoveries()["everyone eats"].final_state()
+    assert all(p.phase == HAS_LEFT for p in final.actor_states[:3])
+
+
+def test_dining4_scales():
+    m = _no_early_exit(dining_model(4))
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 15)
+    assert h.unique_state_count() == c.unique_state_count() > DINING3_FULL
