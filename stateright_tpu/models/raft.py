@@ -220,11 +220,36 @@ def main(argv=None) -> None:
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
         raft_model(n).checker().serve(addr)
 
+    def spawn_cmd(rest):
+        from ..actor.spawn import spawn
+
+        n = int(rest[0]) if rest else 3
+        base = int(rest[1]) if len(rest) > 1 else 3000
+        ids = [Id.from_addr("127.0.0.1", base + i) for i in range(n)]
+        print(f"Spawning a {n}-server Raft cluster on 127.0.0.1:"
+              f"{base}..{base + n - 1} (ctrl-c to stop)")
+        spawn(
+            [
+                (
+                    ids[i],
+                    RaftServer(
+                        peers=[x for x in ids if x != ids[i]],
+                        cluster=n,
+                        max_term=1 << 20,
+                        timer_range=(0.15, 0.5),
+                    ),
+                )
+                for i in range(n)
+            ],
+            background=False,
+        )
+
     run_cli(
         "raft [SERVER_COUNT] [NETWORK]",
         check,
         check_tpu=check_tpu,
         explore=explore,
+        spawn=spawn_cmd,
         argv=argv,
     )
 
