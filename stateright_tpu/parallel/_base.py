@@ -94,11 +94,25 @@ class WavefrontChecker(Checker):
         # model) in the caller's thread: raised inside the daemon worker they
         # would only hit stderr and leave the checker silently never-done.
         self._pre_run_validate()
+        self._run_error: Optional[BaseException] = None
         if sync:
             self._run()
         else:
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = threading.Thread(
+                target=self._run_guarded, daemon=True
+            )
             self._thread.start()
+
+    def _run_guarded(self) -> None:
+        """Async-run wrapper: an exception in the run thread (e.g. a
+        multi-controller run hitting a single-controller-only path) must
+        surface at join()/report(), not hang the checker forever with
+        ``_done`` unset and counters silently reading 0."""
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 - re-raised at join()
+            self._run_error = e
+            self._done.set()
 
     def _pre_run_validate(self) -> None:  # engine-specific, optional
         pass
@@ -202,6 +216,8 @@ class WavefrontChecker(Checker):
     def join(self) -> "WavefrontChecker":
         if self._thread is not None:
             self._thread.join()
+        if self._run_error is not None:
+            raise self._run_error
         return self
 
     def state_count(self) -> int:

@@ -176,9 +176,11 @@ class CompiledActorTensor(TensorModel):
         if self.general:
             self._tabulate_properties()
         self._tabulate_boundary()
+        # symmetry tables are built LAZILY (see __getattr__): n!-sized
+        # permutation tabulation should cost nothing on runs that never
+        # call .symmetry()
         self._sym_tables = None
-        if self.general:
-            self._try_build_symmetry()
+        self._sym_attempted = False
 
         self.n_slots = n_slots if n_slots is not None else max(
             16, 4 * self.n_actors
@@ -649,6 +651,25 @@ class CompiledActorTensor(TensorModel):
     # -- mechanical device symmetry (general fragment) -----------------------
 
     _SYM_MAX_PERMS = 720  # n! cap: tables are [n!, |universe|]
+
+    def __getattr__(self, name):
+        # ``representative_rows``/``representative_key`` appear on demand:
+        # the engines probe them with hasattr only when .symmetry() was
+        # requested, which is when the permutation tables are first built.
+        # (``__getattr__`` fires only after normal lookup fails, so once
+        # built the instance attributes take over.)
+        if name in ("representative_rows", "representative_key"):
+            d = self.__dict__
+            if (
+                not d.get("_sym_attempted", True)
+                and d.get("_sym_tables") is None
+                and d.get("general")
+            ):
+                self._sym_attempted = True
+                self._try_build_symmetry()
+            if name in self.__dict__:
+                return self.__dict__[name]
+        raise AttributeError(name)
 
     def _try_build_symmetry(self) -> None:
         """Mechanical symmetry reduction for compiled models whose actors

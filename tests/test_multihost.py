@@ -56,3 +56,23 @@ def test_sharded_engine_multi_controller_2pc3():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
         assert f"multihost-worker-ok p{pid}" in out, out[-2000:]
+
+
+def test_async_run_thread_error_surfaces_at_join(monkeypatch):
+    """A single-controller-only path hit inside an ASYNC run (e.g. mid-run
+    growth under multi-controller SPMD) must raise at join(), not leave a
+    forever-undone checker with counters silently reading 0."""
+    import pytest
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.parallel import sharded
+
+    m = TwoPhaseSys(4)
+    # simulate a second controller process so the growth guard trips; the
+    # tiny capacity forces a mid-run growth event
+    monkeypatch.setattr(sharded.jax, "process_count", lambda: 2)
+    c = m.checker().spawn_tpu(
+        sync=False, devices=8, capacity=1 << 8, frontier_capacity=1 << 5
+    )
+    with pytest.raises(NotImplementedError, match="single-controller"):
+        c.join()
