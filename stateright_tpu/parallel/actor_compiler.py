@@ -173,8 +173,7 @@ class CompiledActorTensor(TensorModel):
             )
 
         self._closure()
-        if self.general:
-            self._tabulate_properties()
+        self._tabulate_properties()
         self._tabulate_boundary()
         # symmetry tables are built LAZILY (see __getattr__): n!-sized
         # permutation tabulation should cost nothing on runs that never
@@ -274,11 +273,23 @@ class CompiledActorTensor(TensorModel):
                 "history must be a LinearizabilityTester (register "
                 "workload), or None for the general fragment"
             )
-        names = sorted(p.name for p in m.properties())
-        if names != ["linearizable", "value chosen"]:
+        from ..actor.device_props import FactoredPredicate as _FP2
+
+        std = {"linearizable", "value chosen"}
+        extra_bad = sorted(
+            p.name
+            for p in m.properties()
+            if p.name not in std and not isinstance(p.condition, _FP2)
+        )
+        names = sorted(p.name for p in m.properties() if p.name in std)
+        if names != ["linearizable", "value chosen"] or extra_bad:
             raise CompileError(
-                "compilable property set is exactly "
-                "{'linearizable', 'value chosen'}; got " + repr(names)
+                "register workloads compile {'linearizable', 'value "
+                "chosen'} plus any number of factored predicates "
+                "(actor/device_props.py); got standard="
+                + repr(names)
+                + " non-factored extras="
+                + repr(extra_bad)
             )
         from ..actor.register import record_invocations, record_returns
         from ..actor.write_once_register import (
@@ -585,11 +596,18 @@ class CompiledActorTensor(TensorModel):
         """Freeze each factored property's predicate into per-actor (or
         per-pair) boolean tables over the compiled state universes.  The
         host evaluates the same predicate directly, so agreement is by
-        construction."""
+        construction.  Register workloads tabulate their factored EXTRAS
+        only (``None`` marks the two standard history-driven properties,
+        which ``property_masks`` computes from the history fields)."""
+        from ..actor.device_props import FactoredPredicate
+
         self._prop_tables = []
         n = self.n_actors
         for p in self.model.properties():
-            f = p.condition  # a FactoredPredicate (checked in the fragment)
+            f = p.condition
+            if not isinstance(f, FactoredPredicate):
+                self._prop_tables.append(None)  # standard register property
+                continue
             try:
                 if f.kind in ("forall", "exists"):
                     tables = [
@@ -989,16 +1007,17 @@ class CompiledActorTensor(TensorModel):
                 self._device_consts["boundary"] = [
                     jnp.asarray(t) for t in self._boundary_np
                 ]
-            if self.general:
-                self._device_consts["props"] = [
-                    (
-                        kind,
-                        [jnp.asarray(t) for t in tables]
-                        if isinstance(tables, list)
-                        else {k: jnp.asarray(v) for k, v in tables.items()},
-                    )
-                    for kind, tables in self._prop_tables
-                ]
+            self._device_consts["props"] = [
+                None
+                if entry is None
+                else (
+                    entry[0],
+                    [jnp.asarray(t) for t in entry[1]]
+                    if isinstance(entry[1], list)
+                    else {k: jnp.asarray(v) for k, v in entry[1].items()},
+                )
+                for entry in self._prop_tables
+            ]
         return self._device_consts
 
     def step_rows(self, rows):
@@ -1318,28 +1337,32 @@ class CompiledActorTensor(TensorModel):
         i32, u64 = jnp.int32, jnp.uint64
         pk = self.pk
 
-        if self.general:
+        def eval_factored(entry):
+            import jax.numpy as jnp_
+
             n = self.n_actors
             codes = [
                 pk.get(rows, f"a{i}").astype(i32) for i in range(n)
             ]
-            B = rows.shape[0]
-            masks = []
-            for kind, tables in cst["props"]:
-                if kind in ("forall", "exists"):
-                    per = [tables[i][codes[i]] for i in range(n)]
-                    v = per[0]
-                    for x in per[1:]:
-                        v = (v & x) if kind == "forall" else (v | x)
-                else:
-                    conj = kind == "forall_pairs"
-                    v = jnp.full((B,), conj, bool)
-                    for i in range(n):
-                        for j in range(i + 1, n):
-                            x = tables[(i, j)][codes[i], codes[j]]
-                            v = (v & x) if conj else (v | x)
-                masks.append(v)
-            return jnp.stack(masks, axis=-1)
+            kind, tables = entry
+            if kind in ("forall", "exists"):
+                per = [tables[i][codes[i]] for i in range(n)]
+                v = per[0]
+                for x in per[1:]:
+                    v = (v & x) if kind == "forall" else (v | x)
+                return v
+            conj = kind == "forall_pairs"
+            v = jnp_.full((rows.shape[0],), conj, bool)
+            for i in range(n):
+                for j in range(i + 1, n):
+                    x = tables[(i, j)][codes[i], codes[j]]
+                    v = (v & x) if conj else (v | x)
+            return v
+
+        if self.general:
+            return jnp.stack(
+                [eval_factored(e) for e in cst["props"]], axis=-1
+            )
 
         phases = jnp.stack(
             [pk.get(rows, f"h{c}_phase").astype(i32) for c in range(self.C)],
@@ -1375,5 +1398,11 @@ class CompiledActorTensor(TensorModel):
 
         masks = {"linearizable": linearizable, "value chosen": chosen}
         return jnp.stack(
-            [masks[p.name] for p in self.model.properties()], axis=-1
+            [
+                masks[p.name]
+                if cst["props"][k] is None
+                else eval_factored(cst["props"][k])
+                for k, p in enumerate(self.model.properties())
+            ],
+            axis=-1,
         )

@@ -9,6 +9,7 @@ table-for-table: pinned counts 544 (ABD, reference
 
 import pytest
 
+from stateright_tpu.core import Expectation
 from stateright_tpu.models.linearizable_register import abd_model
 from stateright_tpu.models.paxos import paxos_model
 from stateright_tpu.models.single_copy_register import single_copy_model
@@ -328,3 +329,45 @@ def test_paxos_ordered_engine_parity():
     tpu = build().checker().spawn_tpu(sync=True)
     assert cpu.unique_state_count() == tpu.unique_state_count() == 99
     assert set(cpu.discoveries()) == set(tpu.discoveries())
+
+
+def test_register_workload_accepts_extra_factored_properties():
+    """Register workloads compile the two standard history-driven
+    properties PLUS any factored extras — evaluated as tabulated lookups
+    on device, the same predicate directly on host."""
+    from stateright_tpu.actor.device_props import exists_actor, forall_actors
+    from stateright_tpu.actor.register import NULL_VALUE
+    from stateright_tpu.models.single_copy_register import single_copy_model
+
+    m = single_copy_model(2, 1)
+    m.property(
+        Expectation.ALWAYS,
+        "server value known",  # holds: never discovered
+        forall_actors(lambda i, s: i != 0 or s in (NULL_VALUE, "A", "B")),
+    )
+    m.property(
+        Expectation.SOMETIMES,
+        "server took a write",  # discovered once a put lands
+        exists_actor(lambda i, s: i == 0 and s in ("A", "B")),
+    )
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 13)
+    assert h.unique_state_count() == c.unique_state_count() == 93
+    assert (
+        sorted(h.discoveries())
+        == sorted(c.discoveries())
+        == ["server took a write", "value chosen"]
+    )
+
+
+def test_register_workload_rejects_non_factored_extras():
+    from stateright_tpu.models.single_copy_register import single_copy_model
+    from stateright_tpu.parallel.actor_compiler import (
+        CompileError,
+        compile_actor_model,
+    )
+
+    m = single_copy_model(2, 1)
+    m.property(Expectation.ALWAYS, "opaque", lambda mm, s: True)
+    with pytest.raises(CompileError, match="factored"):
+        compile_actor_model(m)
