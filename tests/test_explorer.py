@@ -287,3 +287,34 @@ def test_serve_tpu_live_status_mid_run():
     finally:
         server.checker._stop.set()
         server.shutdown()
+
+
+def test_explorer_serves_general_fragment_tpu_run():
+    """The Explorer browses a device run of the compiled general fragment
+    (raft): live status, discovery path links, and state pages with the
+    per-step outcomes."""
+    from stateright_tpu.models.raft import raft_model
+
+    server = serve(
+        raft_model(3).checker(),
+        "localhost:0",
+        strategy="tpu",
+        block=False,
+        sync=True,
+        capacity=1 << 14,
+    )
+    try:
+        server.checker.join()
+        s = get(server, "/.status")
+        assert s["done"] is True
+        assert s["unique_state_count"] == 5_725
+        props = {name: disc for _, name, disc in s["properties"]}
+        assert props["a leader is elected"] is not None
+        # follow the witness path to its final state page
+        code, view = get_status(
+            server, "/.states/" + props["a leader is elected"]
+        )
+        assert code == 200
+        assert isinstance(view, list)
+    finally:
+        server.shutdown()
