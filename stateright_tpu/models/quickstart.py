@@ -103,6 +103,51 @@ def clock_counterexample(limit: int = 3):
     return checker.discovery("less than max")
 
 
+# -- the served toy model (reference ``checker.rs:60-97``) --------------------
+
+
+class FizzBuzz(Model):
+    """The reference's ``serve`` doctest model: states are the emitted
+    prefix of the fizz-buzz sequence, bounded by ``max``; serving it gives
+    a browsable state space (``FizzBuzz(30).checker().serve(addr)``)."""
+
+    def __init__(self, max: int = 30):
+        super().__init__()
+        self.max = max
+
+    def init_states(self):
+        return [()]
+
+    def actions(self, state):
+        n = len(state)
+        if n % 15 == 0:
+            return ["fizzbuzz"]
+        if n % 5 == 0:
+            return ["buzz"]
+        if n % 3 == 0:
+            return ["fizz"]
+        return [None]
+
+    def next_state(self, state, action):
+        return state + ((len(state), action),)
+
+    def within_boundary(self, state) -> bool:
+        return len(state) <= self.max
+
+    def properties(self):
+        return [
+            Property.sometimes(
+                "reaches the bound", lambda m, s: len(s) == m.max
+            )
+        ]
+
+
+def serve_fizzbuzz(addr: str = "localhost:3000", block: bool = True):
+    """``FizzBuzz(30).checker().serve(addr)`` — the reference's front-page
+    Explorer example (``checker.rs:60-97``)."""
+    return FizzBuzz(30).checker().serve(addr, block=block)
+
+
 # -- vector clocks: detecting concurrency -------------------------------------
 
 

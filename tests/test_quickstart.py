@@ -38,3 +38,31 @@ def test_clock_dfs_agrees():
         "less than max" in bfs.discoveries()
         and "less than max" in dfs.discoveries()
     )
+
+
+def test_fizzbuzz_served_model():
+    """The reference's serve doctest (``checker.rs:60-97``) as a live
+    server: a browsable bounded sequence with its reach-the-bound witness."""
+    from stateright_tpu.models.quickstart import FizzBuzz, serve_fizzbuzz
+
+    server = serve_fizzbuzz("localhost:0", block=False)
+    try:
+        server.checker.join()
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://{server.addr}/.status"
+        ) as r:
+            s = json.loads(r.read())
+        assert s["done"] is True
+        assert s["unique_state_count"] == 31  # prefixes of length 0..30
+        assert dict(
+            (name, disc) for _, name, disc in s["properties"]
+        )["reaches the bound"] is not None
+    finally:
+        server.shutdown()
+    # the checker surface works standalone too
+    c = FizzBuzz(30).checker().spawn_bfs().join()
+    assert c.unique_state_count() == 31
+    c.assert_properties()
