@@ -233,3 +233,24 @@ def test_factored_predicates_evaluate_on_host():
     cond = exists_actor(lambda i, s: s.role == LEADER)
     final = checker.discoveries()["a leader is elected"].final_state()
     assert cond(m, final)
+
+
+def test_exists_actor_pair_quantifier():
+    """Coverage for the fourth factored quantifier: a sometimes-property
+    over actor PAIRS (two servers granted to the same candidate) agrees
+    host=device."""
+    from stateright_tpu.actor.device_props import exists_actor_pair
+
+    m = raft_model(3)
+    m.property(
+        Expectation.SOMETIMES,
+        "two granted the same candidate",
+        exists_actor_pair(
+            lambda i, si, j, sj: si.voted_for != -1
+            and si.voted_for == sj.voted_for
+        ),
+    )
+    h = m.checker().spawn_bfs().join()
+    c = m.checker().spawn_tpu(sync=True, capacity=1 << 14)
+    assert "two granted the same candidate" in h.discoveries()
+    assert "two granted the same candidate" in c.discoveries()
