@@ -203,6 +203,28 @@ def main(argv=None) -> None:
             default_threads()
         ).spawn_bfs().report()
 
+    def check_sym(rest):
+        n, network = parse(rest)
+        print(
+            f"Model checking Raft leader election with {n} servers "
+            "(symmetry-reduced DFS)."
+        )
+        raft_model(n, network=network).checker().symmetry().threads(
+            default_threads()
+        ).spawn_dfs().report()
+
+    def check_sym_tpu(rest):
+        n, network = parse(rest)
+        print(
+            f"Model checking Raft leader election with {n} servers on the "
+            "device wavefront engine (mechanical symmetry reduction)."
+        )
+        m = raft_model(n, network=network)
+        if m.tensor_model() is None:
+            print("this configuration has no device twin; use `check-sym`")
+            return
+        m.checker().symmetry().spawn_tpu().report()
+
     def check_tpu(rest):
         n, network = parse(rest)
         print(
@@ -247,7 +269,9 @@ def main(argv=None) -> None:
     run_cli(
         "raft [SERVER_COUNT] [NETWORK]",
         check,
+        check_sym=check_sym,
         check_tpu=check_tpu,
+        check_sym_tpu=check_sym_tpu,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,
