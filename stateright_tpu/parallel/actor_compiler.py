@@ -1304,6 +1304,15 @@ class CompiledActorTensor(TensorModel):
     def has_boundary(self) -> bool:
         return self._boundary_np is not None
 
+    def poison_rows(self, rows):
+        """True per row iff a compile-time bound was crossed reaching it —
+        the engines turn any poisoned POPPED row into a loud run failure
+        (silent wrong counts otherwise: poisoned rows dedup onto their
+        self-loop and quietly truncate the space)."""
+        import jax.numpy as jnp
+
+        return self.pk.get(rows, "poison").astype(jnp.int32) == 1
+
     def boundary_rows(self, rows):
         """``within_boundary`` over encoded rows (the device analogue of the
         host checkers' boundary filter; ``step_rows`` itself mirrors the

@@ -84,6 +84,7 @@ _FRONTIER_OVERFLOW = 1
 _TABLE_OVERFLOW = 2
 _BUCKET_OVERFLOW = 3
 _CAND_OVERFLOW = 4  # valid candidates exceeded the compaction budget
+_POISON = 5  # a compiled-twin transition crossed its compile bound
 
 AXIS = "d"
 
@@ -122,6 +123,7 @@ def _build_sharded_run(
         if getattr(tensor, "has_boundary", False)
         else None
     )
+    poison_fn = getattr(tensor, "poison_rows", None)
     m_cand = fcap_local * arity
     if cand_local is not None:
         cand_local = min(cand_local, ndev * bucket_cap)
@@ -340,6 +342,17 @@ def _build_sharded_run(
                     ),
                 ),
             )
+            if poison_fn is not None:
+                # a poisoned expanded row = a compile-time bound crossed by
+                # a reachable transition; terminal, host raises (growth
+                # cannot fix a bound).  pmax: any shard poisons the run.
+                status = jnp.where(
+                    jax.lax.pmax(
+                        jnp.any(poison_fn(rows) & live), AXIS
+                    ),
+                    jnp.int32(_POISON),
+                    status,
+                )
             depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
             return (tfp, tpl, nrows, nfps, nebt, unique, scount, disc,
                     depth, status)
@@ -679,6 +692,14 @@ class ShardedTpuChecker(WavefrontChecker):
                     break
                 out = step_fn(*carry)
                 from_init = False
+            if status == _POISON:
+                raise RuntimeError(
+                    "poisoned rows reached by the device run: a compiled "
+                    "transition crossed its compile-time state_bound/"
+                    "env_bound, so counts would be silently wrong. Loosen "
+                    "the bounds (they must cover everything the bounded "
+                    "configuration actually reaches)."
+                )
             if status != _OK and not self._stop.is_set():
                 if from_init:
                     # init overflow: nothing ran yet, so a plain re-init at

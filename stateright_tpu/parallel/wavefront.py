@@ -75,6 +75,7 @@ _STATUS_OK = 0
 _STATUS_QUEUE_FULL = 1
 _STATUS_TABLE_FULL = 2
 _STATUS_CAND_FULL = 3  # valid candidates exceeded the compaction budget
+_STATUS_POISON = 4  # a compiled-twin transition crossed its compile bound
 
 # Carry tuple indices (shared by the jitted program and the host loop).
 # No occupancy-counts buffer exists: bucket occupancy is implicit in the
@@ -172,6 +173,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         if getattr(tensor, "has_boundary", False)
         else None
     )
+    poison_fn = getattr(tensor, "poison_rows", None)
 
     def step(carry):
         """Pop one batch, expand, dedup+insert, append novel rows."""
@@ -254,6 +256,16 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                 jnp.where(tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status),
             ),
         )
+        if poison_fn is not None:
+            # a poisoned popped row means a compile-time bound was crossed
+            # by a REACHABLE transition — silently wrong counts otherwise;
+            # surface it as a terminal host-visible status (takes priority
+            # over growth: growing cannot fix a bound)
+            status = jnp.where(
+                jnp.any(poison_fn(rows) & live),
+                jnp.int32(_STATUS_POISON),
+                status,
+            )
         return (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
                 unique, scount, disc, maxdepth, status)
 
@@ -568,6 +580,14 @@ class TpuChecker(WavefrontChecker):
                 self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap, cand)
                 self._ckpt_req.clear()
                 self._ckpt_ready.set()
+            if status == _STATUS_POISON:
+                raise RuntimeError(
+                    "poisoned rows reached by the device run: a compiled "
+                    "transition crossed its compile-time state_bound/"
+                    "env_bound, so counts would be silently wrong. Loosen "
+                    "the bounds (they must cover everything the bounded "
+                    "configuration actually reaches)."
+                )
             if status != _STATUS_OK:
                 self.growth_events.append((status, unique))
                 if status == _STATUS_CAND_FULL:

@@ -254,3 +254,25 @@ def test_exists_actor_pair_quantifier():
     c = m.checker().spawn_tpu(sync=True, capacity=1 << 14)
     assert "two granted the same candidate" in h.discoveries()
     assert "two granted the same candidate" in c.discoveries()
+
+
+def test_too_tight_compile_bound_fails_loudly():
+    """A state_bound that cuts REACHABLE states must fail the run, not
+    silently truncate the space (poisoned rows previously deduped onto
+    self-loops and produced a plausible-looking wrong count)."""
+    from stateright_tpu.parallel.actor_compiler import compile_actor_model
+
+    m = raft_model(3)  # reaches term 2; bound it at 1
+    tm = compile_actor_model(
+        m,
+        state_bound=lambda i, s: s.term <= 1,
+        env_bound=lambda e: e.msg[1] <= 1,
+    )
+    m.tensor_model = lambda: tm
+    with pytest.raises(RuntimeError, match="poisoned"):
+        m.checker().spawn_tpu(sync=True, capacity=1 << 14)
+    with pytest.raises(RuntimeError, match="poisoned"):
+        m.checker().spawn_tpu(
+            sync=True, devices=8, capacity=1 << 14,
+            frontier_capacity=1 << 9,
+        )
