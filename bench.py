@@ -8,16 +8,34 @@ CPU BfsChecker on ``paxos check 3``.  Protocol mirrors the reference's
 Output contract: this script prints complete JSON lines — the LAST line is
 the result.  Earlier rounds emitted exactly once, at the very end, and
 round 3's artifact was ``rc=124, parsed=null`` because the driver's outer
-timeout fired first.  Round 4 therefore emits **incrementally**:
+timeout fired first.  Round 4 emitted **incrementally** (one line per
+milestone) but packed every detail — attempt records, probe stack dumps —
+into each line; the driver stores only a ~2KB *tail* of stdout
+(BENCH_r04.json's ``tail`` starts mid-line), so the oversized final line
+could never parse.  Round 5's contract therefore has two more rules:
 
- - one line the moment the CPU phase lands,
- - an updated line after EVERY TPU milestone (the parent tails the child's
-   stage file while the child runs),
- - a final line before the script's own deadline.
+ - **Every stdout line is small** (hard cap ``MAX_LINE_BYTES``): only
+   scalar headline keys.  Full details go to stderr and a side file
+   (``docs/bench-last-details.json``), never stdout.
+ - **Every line carries a real number.**  ``BENCH_VALIDATED.json`` (repo
+   root, committed) stores the most recent chip-validated result with
+   provenance; when the tunnel is dead the emitted line degrades to that
+   stale-but-validated number with ``fresh: false`` + ``validated_at`` +
+   an ``error`` — never to ``value: 0`` or an unparseable line.  A fresh
+   successful run rewrites the file.
 
-A kill at any instant now truncates the extras instead of zeroing the
-artifact.  ``value``/``vs_baseline`` are recomputed on every emit from
-whatever numbers exist so far.
+``value``/``vs_baseline`` are recomputed on every emit from whatever
+numbers exist so far.
+
+Baseline definition (the ONE honest story — README, BASELINE.md and this
+script agree): ``vs_baseline`` = TPU paxos-3 states/s ÷ **uncontended
+single-core CPU BFS states/s of this framework's own engine** (the Rust
+reference cannot be built here — no cargo toolchain — so the reference's
+multithreaded CPU BfsChecker is approximated by this framework's CPU
+engine; see BASELINE.md).  The same-invocation CPU run is used only when
+it is actually uncontended (within 80% of the stored uncontended rate);
+otherwise the stored uncontended rate is used and the contention is
+recorded (``cpu_baseline_src``, ``cpu_load1``).
 
 Phase structure (see docs/axon-init-hang.md for the diagnosis that shaped
 it — the historical "init hang" is the loopback tunnel's far end being
@@ -58,6 +76,28 @@ CPU_TARGET = 12_000  # unique-state cap for the CPU paxos-3 baseline prefix
 T0 = time.monotonic()
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", "1500"))
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+VALIDATED_PATH = os.environ.get(
+    "BENCH_VALIDATED_FILE", os.path.join(_HERE, "BENCH_VALIDATED.json")
+)
+DETAILS_PATH = os.environ.get(
+    "BENCH_DETAILS_FILE", os.path.join(_HERE, "docs", "bench-last-details.json")
+)
+# the driver keeps only a ~2KB tail of stdout; a line longer than that
+# window can never parse (the BENCH_r04 failure mode).  Stay far under it.
+MAX_LINE_BYTES = 1000
+
+
+def _load_validated() -> dict:
+    try:
+        with open(VALIDATED_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+VALIDATED = _load_validated()
+
 
 def remaining() -> float:
     return DEADLINE_S - (time.monotonic() - T0)
@@ -65,44 +105,208 @@ def remaining() -> float:
 
 EXTRAS: dict = {}
 _last_emitted = None
+_last_details = None
+
+# stdout whitelist, highest-priority first: when the line would exceed
+# MAX_LINE_BYTES, keys are dropped from the END of this list until it fits
+# (the first four are the driver's contract and are never dropped).
+_LINE_KEYS = (
+    "metric", "value", "unit", "vs_baseline",
+    "fresh", "validated_at", "error",
+    "tpu_paxos3_states_per_sec", "tpu_paxos3_unique", "tpu_paxos3_sec",
+    "cpu_baseline_states_per_sec", "cpu_baseline_src", "cpu_cores",
+    "cpu_load1", "baseline_def", "insert_path", "parity", "details",
+)
+
+
+def _cpu_baseline() -> tuple:
+    """(rate, src, uncontended): the single source of the baseline-selection
+    rule.  The same-invocation CPU run counts as uncontended when the box
+    was idle at phase start (load1 < 0.7 — the probe child no longer
+    overlaps the primary CPU run, see main()) or when it reaches 80% of
+    the stored uncontended rate.  Replace-not-ratchet: an idle same-run
+    measurement may legitimately be LOWER than the stored rate (slower
+    box, slower engine) and still wins."""
+    cpu_same = EXTRAS.get("cpu_paxos3_states_per_sec")
+    cpu_stored = VALIDATED.get("cpu_paxos3_uncontended_states_per_sec")
+    if not cpu_same:
+        if cpu_stored:
+            return cpu_stored, "stored-uncontended (cpu phase failed)", False
+        return None, None, False
+    load1 = EXTRAS.get("cpu_load1")
+    uncontended = (load1 is not None and load1 < 0.7) or (
+        bool(cpu_stored) and cpu_same >= 0.8 * cpu_stored
+    )
+    if uncontended or not cpu_stored:
+        src = "same-run" if uncontended else (
+            f"same-run (unverified: load1={load1}, nothing stored)"
+        )
+        return cpu_same, src, uncontended
+    return (
+        cpu_stored,
+        f"stored-uncontended (same-run contended: {cpu_same:.0f}/s, "
+        f"load1={load1})",
+        False,
+    )
+
+
+def _compute_headline() -> dict:
+    """value/vs_baseline + provenance fields from EXTRAS ∪ VALIDATED.
+    Returned keys OVERRIDE the raw extras in the emitted record (merge
+    order in emit()), so when the Pallas path wins, the describing fields
+    (sec) are replaced by the Pallas run's own — value, sec and unique
+    must stay mutually consistent on every line."""
+    out: dict = {}
+    cpu_base, cpu_src, _ = _cpu_baseline()
+    if cpu_base is not None:
+        out["cpu_baseline_states_per_sec"] = cpu_base
+        out["cpu_baseline_src"] = cpu_src
+    out["baseline_def"] = "uncontended single-core CPU BFS (this framework)"
+    # -- value: fresh chip number if we have one, else last validated --
+    tpu_sps = EXTRAS.get("tpu_paxos3_states_per_sec")
+    pallas_sps = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
+    if tpu_sps is not None and pallas_sps is not None:
+        if pallas_sps > tpu_sps:
+            out["insert_path"] = "pallas"
+            tpu_sps = pallas_sps
+            out["tpu_paxos3_states_per_sec"] = pallas_sps
+            if EXTRAS.get("tpu_paxos3_pallas_sec") is not None:
+                out["tpu_paxos3_sec"] = EXTRAS["tpu_paxos3_pallas_sec"]
+        else:
+            out["insert_path"] = "xla-scatter"
+    if tpu_sps is not None:
+        out["value"], out["fresh"] = tpu_sps, True
+    elif VALIDATED.get("tpu_paxos3_states_per_sec") is not None:
+        out["value"] = VALIDATED["tpu_paxos3_states_per_sec"]
+        out["fresh"] = False
+        out["validated_at"] = VALIDATED.get("validated_at")
+        for k in ("tpu_paxos3_states_per_sec", "tpu_paxos3_unique",
+                  "tpu_paxos3_sec"):
+            if k in VALIDATED:
+                out.setdefault(k, VALIDATED[k])
+    else:
+        out["value"], out["fresh"] = 0.0, False
+    out["vs_baseline"] = (
+        round(out["value"] / cpu_base, 3) if cpu_base and out["value"] else 0.0
+    )
+    return out
 
 
 def emit(_clear=(), **updates) -> None:
-    """Print a COMPLETE result line (the driver parses the last line).
-    value/vs_baseline are recomputed from the extras every time, so every
-    line is a valid final answer for everything known so far.  ``_clear``
-    names keys to REMOVE from the cumulative extras — a stale ``error``
-    from a failed attempt must not survive into the line emitted after a
-    later successful retry (plain dict.update can never delete)."""
-    global _last_emitted
+    """Print a COMPLETE, SMALL result line (the driver parses the last
+    stdout line out of a ~2KB tail window, so every line must stay under
+    MAX_LINE_BYTES).  Full cumulative details go to DETAILS_PATH and
+    stderr instead.  value/vs_baseline are recomputed every time, so every
+    line is a valid final answer for everything known so far; when no
+    fresh chip number exists yet, the line carries the last chip-validated
+    number (``fresh: false`` + ``validated_at``) so a dead tunnel degrades
+    to a stale-but-real value, never 0/unparseable.  ``_clear`` names keys
+    to REMOVE from the cumulative extras — a stale ``error`` from a failed
+    attempt must not survive a later successful retry."""
+    global _last_emitted, _last_details
     for k in _clear:
         EXTRAS.pop(k, None)
     EXTRAS.update(updates)
-    cpu_sps = EXTRAS.get("cpu_paxos3_states_per_sec")
-    tpu_sps = EXTRAS.get("tpu_paxos3_states_per_sec")
-    pallas_sps = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
-    value, vs = 0.0, 0.0
-    if tpu_sps is not None:
-        value = tpu_sps
-        if pallas_sps is not None:
-            EXTRAS["insert_path"] = (
-                "pallas" if pallas_sps > tpu_sps else "xla-scatter"
-            )
-            value = max(tpu_sps, pallas_sps)
-        if cpu_sps:
-            vs = round(value / cpu_sps, 3)
-    line = json.dumps(
-        {
-            "metric": "paxos check 3 states/sec (TPU wavefront)",
-            "value": value,
-            "unit": "states/sec",
-            "vs_baseline": vs,
-            **EXTRAS,
-        }
-    )
+    full = {
+        "metric": "paxos check 3 states/sec (TPU wavefront)",
+        "unit": "states/sec",
+        **{k: v for k, v in EXTRAS.items() if k not in ("value", "unit")},
+        **_compute_headline(),  # AFTER extras: headline fields override
+        "details": os.path.relpath(DETAILS_PATH, _HERE),
+    }
+    # full detail record: side file, never stdout.  Deduped on the full
+    # dict (not the headline line): the ~5s watchdog re-emits of unchanged
+    # salvage must not rewrite the file, but a milestone that only adds a
+    # secondary config number still must.
+    blob = json.dumps(full, indent=1)
+    if blob != _last_details:
+        try:
+            with open(DETAILS_PATH, "w") as f:
+                f.write(blob)
+            _last_details = blob
+        except OSError as e:
+            # the side file is the details' only home; if it is unwritable
+            # they survive on stderr instead (docstring contract)
+            full.pop("details", None)
+            sys.stderr.write(f"bench: details file unwritable ({e}); "
+                             f"details follow:\n{blob}\n")
+            _last_details = blob
+    small = {k: full[k] for k in _LINE_KEYS if full.get(k) is not None}
+    if "error" in small:
+        small["error"] = str(small["error"])[:140]
+    line = json.dumps(small)
+    drop = len(_LINE_KEYS) - 1
+    while len(line.encode()) > MAX_LINE_BYTES and drop >= 4:
+        small.pop(_LINE_KEYS[drop], None)
+        drop -= 1
+        line = json.dumps(small)
     if line != _last_emitted:
         print(line, flush=True)
+        sys.stderr.write(f"bench: emitted {len(line)}B headline line\n")
         _last_emitted = line
+
+
+def record_validated() -> None:
+    """Persist the freshly chip-validated result (+ the uncontended CPU
+    baseline when this run's CPU phase was uncontended) so future
+    invocations under a dead tunnel can still emit a real number.
+
+    A BENCH_TPU_TARGET prefix run is NOT persisted: its rate is dominated
+    by fixed overhead and is not comparable to the full-enumeration
+    headline — overwriting the stored full-run number with it would poison
+    every later dead-tunnel emission."""
+    if os.environ.get("BENCH_TPU_TARGET", ""):
+        sys.stderr.write(
+            "bench: prefix run (BENCH_TPU_TARGET set) — not persisting to "
+            "BENCH_VALIDATED.json\n"
+        )
+        return
+    # "parity gates passed" must mean the DEVICE gates actually ran: a
+    # salvaged partial (killed after the timed run, before the 2pc5 gate)
+    # or an errored phase is a real number but not a validated one
+    if (
+        "error" in EXTRAS
+        or not EXTRAS.get("tpu_paxos2_discoveries")
+        or not EXTRAS.get("tpu_2pc5_discoveries")
+    ):
+        sys.stderr.write(
+            "bench: partial/errored TPU phase (device parity gates "
+            "incomplete) — not persisting to BENCH_VALIDATED.json\n"
+        )
+        return
+    doc = {
+        "tpu_paxos3_states_per_sec": EXTRAS.get("tpu_paxos3_states_per_sec"),
+        "tpu_paxos3_unique": EXTRAS.get("tpu_paxos3_unique"),
+        "tpu_paxos3_sec": EXTRAS.get("tpu_paxos3_sec"),
+        "tpu_devices": EXTRAS.get("tpu_devices"),
+        "validated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "provenance": "bench.py full run, parity gates passed",
+    }
+    pallas = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
+    if pallas and pallas > (doc["tpu_paxos3_states_per_sec"] or 0):
+        doc["tpu_paxos3_states_per_sec"] = pallas
+        doc["tpu_paxos3_sec"] = EXTRAS.get("tpu_paxos3_pallas_sec")
+        doc["provenance"] += " (pallas insert path)"
+    cpu_stored = VALIDATED.get("cpu_paxos3_uncontended_states_per_sec")
+    _, _, uncontended = _cpu_baseline()
+    if uncontended:
+        # replace, don't ratchet: an idle measurement that is LOWER than
+        # the stored rate (slower box, slower engine) is the new truth
+        doc["cpu_paxos3_uncontended_states_per_sec"] = EXTRAS[
+            "cpu_paxos3_states_per_sec"
+        ]
+        doc["cpu_load1"] = EXTRAS.get("cpu_load1")
+    elif cpu_stored:
+        doc["cpu_paxos3_uncontended_states_per_sec"] = cpu_stored
+    if doc["tpu_paxos3_states_per_sec"] is None:
+        return
+    try:
+        with open(VALIDATED_PATH, "w") as f:
+            json.dump(doc, f, indent=1)
+        VALIDATED.clear()
+        VALIDATED.update(doc)
+    except OSError as e:
+        sys.stderr.write(f"bench: could not write BENCH_VALIDATED.json: {e}\n")
 
 
 def timed(spawn):
@@ -118,12 +322,19 @@ def timed(spawn):
 # ---------------------------------------------------------------------------
 
 
-def cpu_phase() -> dict:
+def cpu_phase(on_primary_done=lambda: None) -> dict:
     from stateright_tpu.models.paxos import paxos_model
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
     threads = os.cpu_count() or 1
+    try:
+        load1 = round(os.getloadavg()[0], 2)
+    except OSError:
+        load1 = None
     out: dict = {
+        # contention evidence for the baseline-source decision (see module
+        # docstring): sampled before this phase adds its own load
+        "cpu_load1": load1,
         # honesty note (VERDICT r2 weak #3 / r3 next #3): the thread pool
         # is GIL-bound, so the REAL multi-core baseline is the
         # process-parallel BFS (stateright_tpu/checker/mp.py), reported as
@@ -150,6 +361,10 @@ def cpu_phase() -> dict:
     out["cpu_paxos3_states"] = cpu_p3.state_count()
     out["cpu_paxos3_sec"] = round(dt, 3)
     out["cpu_paxos3_note"] = f"prefix run, target_states={CPU_TARGET}"
+    # the baseline measurement is done — only NOW may the probe child
+    # start: on a single-core box a concurrently-importing probe steals
+    # ~half the primary run's CPU and poisons the uncontended baseline
+    on_primary_done()
 
     # parity gates (pinned counts)
     cpu_p2 = paxos_model(2).checker().threads(threads).spawn_bfs().join()
@@ -396,6 +611,7 @@ def tpu_phase() -> dict:
         out["tpu_paxos3_pallas_states_per_sec"] = round(
             tpu_p3p.state_count() / dtp, 1
         )
+        out["tpu_paxos3_pallas_sec"] = round(dtp, 3)
         _mark("paxos3 pallas A/B done")
     except Exception as e:  # noqa: BLE001
         out["tpu_paxos3_pallas_error"] = f"{type(e).__name__}: {e}"
@@ -520,10 +736,12 @@ def _term_then_kill(proc, grace: float = 5.0):
 
 
 class Probe:
-    """Init-only child started CONCURRENTLY with the CPU phase: ``import
-    jax; jax.devices()`` with a faulthandler stack dump armed, so by the
-    time CPU numbers are in we know whether the backend is reachable —
-    without having burned any serial wall-clock on it."""
+    """Init-only child started right after the primary CPU baseline lands
+    (overlapping the rest of the CPU phase): ``import jax; jax.devices()``
+    with a faulthandler stack dump armed, so by the time CPU numbers are
+    in we know whether the backend is reachable — without having burned
+    serial wall-clock on it, and without contending with the single-core
+    baseline measurement."""
 
     def __init__(self):
         self.t0 = time.monotonic()
@@ -678,7 +896,7 @@ def _run_tpu_child(
 
 def run_tpu_with_budget(budget_s: float, probe: Probe) -> dict:
     """Spend the TPU budget landing numbers — never one attempt.  The probe
-    (already running since before the CPU phase) gates nothing: full
+    (running since the primary CPU baseline landed) gates nothing: full
     attempts start immediately; a probe verdict merely adds evidence.
     Attempts relaunch in fresh children on transient failures until the
     budget is spent.  Results from a killed attempt are salvaged from its
@@ -766,11 +984,11 @@ def main() -> int:
             print(json.dumps(partial))
             return 1
 
-    # the probe starts FIRST and runs concurrently with the CPU phase; its
-    # lifetime is cpu-phase duration + the short result() wait below — a
-    # hung probe never delays the first full attempt, whose own init
-    # watchdog covers the hang
-    probe = Probe()
+    # the probe starts right AFTER the primary CPU baseline lands (its
+    # concurrent import would contend with that single-core measurement)
+    # and overlaps the rest of the CPU phase; a hung probe never delays
+    # the first full attempt, whose own init watchdog covers the hang
+    probe_box: list = []
     # Immunize the PARENT against a dead tunnel: the accelerator site hook
     # force-selects jax_platforms="axon,cpu", so any stray backend touch
     # during the CPU phase (a jnp constant, a debug print of an array)
@@ -785,17 +1003,20 @@ def main() -> int:
     except Exception:  # noqa: BLE001 - defensive only; bench works without
         pass
     try:
-        emit(**cpu_phase())  # line 1: the artifact can never again be empty
+        # line 1: the artifact can never again be empty
+        emit(**cpu_phase(lambda: probe_box.append(Probe())))
     except Exception as e:  # noqa: BLE001 - CPU numbers lost, TPU still runs
         tb = traceback.format_exc().strip().splitlines()
         emit(cpu_phase_error=f"{type(e).__name__}: {e}",
              cpu_trace_tail=tb[-6:])
+    if not probe_box:  # cpu_phase died before the primary baseline landed
+        probe_box.append(Probe())
 
     tpu_budget = min(
         float(os.environ.get("BENCH_TPU_TIMEOUT", "1200")),
         max(remaining() - 30, 60),
     )
-    extras = run_tpu_with_budget(tpu_budget, probe)
+    extras = run_tpu_with_budget(tpu_budget, probe_box[0])
 
     for w in ("paxos2", "2pc5"):
         cpu_d = EXTRAS.get(f"cpu_{w}_discoveries")
@@ -816,6 +1037,9 @@ def main() -> int:
             "paxos check 2 (16668) + 2pc check 5 (8832) on CPU and TPU",
         )
         emit(**extras)
+        # fresh chip-validated number + parity gates passed: persist it so
+        # future dead-tunnel invocations degrade to this instead of 0
+        record_validated()
         # a partial TPU phase can carry the primary metric AND a phase-level
         # error (e.g. the backend died after the timed run): report the
         # number but exit nonzero so automation sees the broken run
