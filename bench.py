@@ -660,8 +660,18 @@ def tpu_phase() -> dict:
             c, dt = timed(
                 lambda: _capped(mm.checker(), target).spawn_tpu(**kw)
             )
+            from stateright_tpu.parallel._base import SMALL_SPACE_BREAK_EVEN
+
             out[f"tpu_{tag}_states_per_sec"] = round(c.state_count() / dt, 1)
             out[f"tpu_{tag}_unique"] = c.unique_state_count()
+            if c.unique_state_count() < SMALL_SPACE_BREAK_EVEN:
+                # the small-space footgun, disclosed per config: below the
+                # break-even the measured "rate" is fixed per-run overhead
+                # and CPU BFS is faster — spawn_auto() picks CPU here
+                out[f"tpu_{tag}_note"] = (
+                    "overhead-dominated small space; spawn_auto() selects "
+                    "the CPU engine for this config"
+                )
             _mark(f"{tag} done")
         except Exception as e:  # noqa: BLE001
             out[f"tpu_{tag}_error"] = f"{type(e).__name__}: {e}"

@@ -30,9 +30,21 @@ Work balance comes from fingerprint uniformity instead of stealing: a 64-bit
 mixed hash spreads any frontier near-evenly across owners, which is the same
 argument the TPU engine rests on.
 
-Limitations (documented, asserted): visitors and symmetry are not supported
-(both need cross-process callbacks with ordering guarantees the oracle tier
-gets from the thread pool instead).  Discovery *paths* are reconstructed by
+**Symmetry reduction** works here (beyond the reference, whose symmetry is
+DFS-only — ``dfs.rs:260-285``): the dedup key becomes
+``stable_hash(representative(state))`` — a pure function, so no
+cross-process state is needed — and successors are routed to
+``owner = class_key % N`` so each symmetry class has exactly one owner.
+The search continues with the *original* state (the ``dfs.py`` subtlety),
+and parent pointers link original fingerprints, so discovery paths are
+genuine action sequences needing no class-matching walk.  Per-round
+arrival batches are folded in worker order, making the reduced counts
+deterministic for a fixed worker count (like the device engines, whose
+counts are pinned per mesh width).
+
+Limitations (documented, asserted): visitors are not supported (they need
+cross-process callbacks with ordering guarantees the oracle tier gets
+from the thread pool instead).  Discovery *paths* are reconstructed by
 the parent from the merged visited map, same as ``bfs.py``.
 """
 
@@ -71,8 +83,6 @@ class MpBfsChecker(ParentPointerTrace, Checker):
     def __init__(self, options: CheckerBuilder, processes: Optional[int] = None):
         if options.visitor_obj is not None:
             raise ValueError("mp BFS does not support visitors; use spawn_bfs")
-        if options.symmetry_fn is not None:
-            raise ValueError("mp BFS does not support symmetry; use spawn_dfs")
         self.model = options.model
         self._props = list(self.model.properties())
         # an EXPLICIT processes count wins verbatim (processes=1 is a valid
@@ -101,6 +111,7 @@ class MpBfsChecker(ParentPointerTrace, Checker):
                 args=(
                     i, n, self.model, self._props, queues, result_q, stats,
                     barrier, options.target_state_count, deadline,
+                    options.symmetry_fn,
                 ),
                 daemon=True,
             )
@@ -129,8 +140,8 @@ class MpBfsChecker(ParentPointerTrace, Checker):
                 w.join(timeout=5)
             raise RuntimeError(msg)
 
-        got = 0
-        while got < n:
+        results: dict[int, tuple] = {}
+        while len(results) < n:
             try:
                 kind, who, payload = result_q.get(timeout=5.0)
             except _queue.Empty:
@@ -142,13 +153,18 @@ class MpBfsChecker(ParentPointerTrace, Checker):
                         "remaining workers terminated"
                     )
                 continue
-            got += 1
             if kind == "error":
                 # peers may be stranded mid-round (their barrier will never
                 # fill) — fail fast rather than waiting for n results
                 _fail("mp BFS worker failed:\n" + payload)
-            visited, disc, count = payload
-            self._generated.update(visited)
+            results[who] = payload
+        # merge in WORKER order, not report-arrival order: when two workers
+        # both discovered a property, the surviving witness fingerprint (and
+        # therefore the reconstructed trace) must not depend on OS scheduling
+        for who in sorted(results):
+            visited, disc, count = results[who]
+            for fp, pfp in visited.values():
+                self._generated[fp] = pfp
             for name, fp in disc.items():
                 self._discoveries.setdefault(name, fp)
             self._count += count
@@ -173,12 +189,13 @@ class MpBfsChecker(ParentPointerTrace, Checker):
 
 
 def _worker_main(
-    me, n, model, props, queues, result_q, stats, barrier, target, deadline
+    me, n, model, props, queues, result_q, stats, barrier, target, deadline,
+    symmetry=None,
 ):
     try:
         _worker_loop(
             me, n, model, props, queues, result_q, stats, barrier, target,
-            deadline,
+            deadline, symmetry,
         )
     except Exception:  # noqa: BLE001 - reported to the parent, peers unblocked
         tb = traceback.format_exc()
@@ -190,13 +207,29 @@ def _worker_main(
 
 
 def _worker_loop(
-    me, n, model, props, queues, result_q, stats, barrier, target, deadline
+    me, n, model, props, queues, result_q, stats, barrier, target, deadline,
+    symmetry=None,
 ):
     prop_count = len(props)
     full_mask = (1 << prop_count) - 1
     prop_index = {p.name: i for i, p in enumerate(props)}
     ebits0 = init_ebits(props)
-    visited: dict[int, int] = {}
+    # dedup/ownership key: the state fingerprint, or under symmetry the
+    # class key stable_hash(representative(state)) — a pure function, so
+    # every worker computes it identically with no shared state (the
+    # dfs.py::_dedup_key scheme; search continues with ORIGINAL states so
+    # parent pointers chain real, re-executable fingerprints)
+    if symmetry is not None:
+        from ..fingerprint import stable_hash
+
+        def dedup_key(state, fp):
+            return stable_hash(symmetry(state))
+    else:
+        def dedup_key(state, fp):
+            return fp
+
+    # key -> (original fp, parent fp); for the plain run key == fp
+    visited: dict[int, tuple] = {}
     discoveries: dict[str, int] = {}
     local_count = 0
 
@@ -210,8 +243,9 @@ def _worker_loop(
         if me == 0:
             local_count += 1
         fp = model.fingerprint_state(s)
-        if fp % n == me and fp not in visited:
-            visited[fp] = 0
+        key = dedup_key(s, fp)
+        if key % n == me and key not in visited:
+            visited[key] = (fp, 0)
             frontier.append((s, fp, ebits0))
 
     rnd = 0
@@ -232,10 +266,11 @@ def _worker_loop(
                 local_count += 1
                 is_terminal = False
                 nfp = model.fingerprint_state(nxt)
-                if nfp in seen_children or nfp == fp:
+                key = dedup_key(nxt, nfp)
+                if key in seen_children or nfp == fp:
                     continue
-                seen_children.add(nfp)
-                buckets[nfp % n].append((nxt, nfp, fp, ebits))
+                seen_children.add(key)
+                buckets[key % n].append((nxt, nfp, fp, ebits, key))
             if is_terminal and ebits:
                 flush_terminal_ebits(props, discoveries, ebits, fp)
 
@@ -243,19 +278,24 @@ def _worker_loop(
         for j in range(n):
             if j != me:
                 queues[j].put((rnd, me, buckets[j]))
-        arrivals = buckets[me]
+        batches = {me: buckets[me]}
         for _ in range(n - 1):
             tag, src, batch = queues[me].get()
             if tag == "abort":
                 raise RuntimeError(f"peer worker {src} failed:\n{batch}")
             assert tag == rnd, f"round skew: got {tag}, at {rnd}"
-            arrivals.extend(batch)
+            batches[src] = batch
 
         frontier = []
-        for state, nfp, pfp, ebits in arrivals:
-            if nfp not in visited:
-                visited[nfp] = pfp
-                frontier.append((state, nfp, ebits))
+        # fold arrivals in worker order, not queue-arrival order: first
+        # insertion decides which ORIGINAL state represents a symmetry
+        # class (and its parent pointer), so a deterministic fold makes
+        # counts and traces reproducible for a fixed worker count
+        for j in sorted(batches):
+            for state, nfp, pfp, ebits, key in batches[j]:
+                if key not in visited:
+                    visited[key] = (nfp, pfp)
+                    frontier.append((state, nfp, ebits))
 
         disc_mask = 0
         for name in discoveries:

@@ -44,6 +44,7 @@ class WorkerPoolChecker(Checker):
         self._state_count_shared = 0
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
+        self._timed_out = False
         self._deadline = (
             time.monotonic() + options.timeout_secs
             if options.timeout_secs is not None
@@ -107,7 +108,13 @@ class WorkerPoolChecker(Checker):
                     continue
             self._check_block(pending)
             if self._deadline is not None and time.monotonic() > self._deadline:
-                self._stop.set()
+                # "timed out" means CUT SHORT: a run whose last block
+                # exhausted the space just past the deadline completed —
+                # only flag when work remains here or in the market (a peer
+                # still holding work runs this same check itself)
+                if pending or self._market.jobs:
+                    self._timed_out = True
+                    self._stop.set()
             if self._stop.is_set():
                 market.close()
                 return
@@ -128,6 +135,14 @@ class WorkerPoolChecker(Checker):
 
     def state_count(self) -> int:
         return self._state_count_shared
+
+    @property
+    def timed_out(self) -> bool:
+        """True when the run was cut short by the builder ``timeout()``
+        deadline (as opposed to finishing, reaching ``target_states``, or
+        discovering every property) — the signal ``spawn_auto()`` uses to
+        decide the space outgrew its CPU probe."""
+        return self._timed_out
 
     def join(self) -> "WorkerPoolChecker":
         for t in self._threads:

@@ -9,17 +9,27 @@ that is engine-independent so semantics fixes land once.
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..checker.base import Checker, CheckerBuilder
 from ..checker.path import Path
 from ..fingerprint import MASK64
 from ..ops.hashing import row_hash
+
+
+# Spaces below this finish in one or two engine calls on hardware: the
+# measured "rate" is fixed per-run overhead, not throughput (bench r4:
+# lin-reg-2, 544 states, 927/s on a v5e vs 7.4k/s on one CPU core).
+# Shared by the engines' footgun warning, spawn_auto's rationale, and the
+# bench's per-config disclosure notes — recalibrate it in ONE place.
+SMALL_SPACE_BREAK_EVEN = 100_000
 
 
 class WavefrontChecker(Checker):
@@ -207,6 +217,28 @@ class WavefrontChecker(Checker):
 
     def _run(self):  # engine-specific
         raise NotImplementedError
+
+    def _warn_small_space(self) -> None:
+        """One-line footgun warning at run end: on real hardware a small
+        space is overhead-dominated and CPU BFS is faster.  Silent on CPU
+        backends (virtual-device test meshes explore small spaces on
+        purpose) and on truncated runs — a run cut short by ``timeout()``,
+        ``stop()``, or ``target_states()`` says nothing about the SPACE
+        being small."""
+        if self._stop.is_set() or self._target is not None:
+            return
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:  # noqa: BLE001 - a warning must never break a run
+            return
+        unique = self._results["unique"] if self._results else 0
+        if platform != "cpu" and 0 < unique < SMALL_SPACE_BREAK_EVEN:
+            print(
+                f"stateright-tpu: note: {unique} unique states is below the "
+                f"~1e5-state overhead break-even on {platform}; "
+                "spawn_auto() or spawn_bfs() is faster for small spaces",
+                file=sys.stderr,
+            )
 
     # -- Checker surface -----------------------------------------------------
 

@@ -740,6 +740,7 @@ class ShardedTpuChecker(WavefrontChecker):
         # keep the final carry device-resident; a stopped run's snapshot
         # keeps more=1 so resume continues it (see _final_snapshot)
         self._final_state = (carry, more, (cap, fcap, bf, cf))
+        self._warn_small_space()
         self._done.set()
 
 

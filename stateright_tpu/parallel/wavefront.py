@@ -637,6 +637,7 @@ class TpuChecker(WavefrontChecker):
             "disc": np.asarray(disc),
             "depth": maxdepth,
         }
+        self._warn_small_space()
         self._done.set()
 
     @property

@@ -77,9 +77,53 @@ def test_mp_worker_error_propagates():
         spawn_mp_bfs(_Exploding(), workers=2)
 
 
-def test_mp_rejects_visitor_and_symmetry():
+def test_mp_rejects_visitor():
     from stateright_tpu.checker.visitor import StateRecorder
 
     b = LinearEquation(1, 2, 3).checker().visitor(StateRecorder())
     with pytest.raises(ValueError, match="visitor"):
         b.spawn_mp_bfs()
+
+
+# Reduced counts are visit-order-dependent (representatives are not
+# class-invariant), but the BSP schedule is deterministic for a fixed
+# worker count, so counts pin EXACTLY per n.  n=1 is FIFO BFS order and
+# equals the host FIFO oracle — the engine-independent parity signal the
+# device engines are pinned against too.
+TPC5_SYM_BY_WORKERS = {1: 508, 2: 723, 4: 665}
+
+
+def test_mp_symmetry_reduces_and_matches_fifo_oracle():
+    """Multi-core CPU + symmetry (reference: DFS-only, ``dfs.rs:260-269``;
+    the round-4 fence ``mp.py:34-36`` is gone): dedup on the class key
+    ``stable_hash(representative(state))`` routed to class owners."""
+    import sys as _sys
+    from pathlib import Path as _P
+
+    _sys.path.insert(0, str(_P(__file__).parent))
+    from test_tensor_models import host_fifo_sym_oracle
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    assert host_fifo_sym_oracle(TwoPhaseSys(5)) == TPC5_SYM_BY_WORKERS[1]
+    for n, expected in TPC5_SYM_BY_WORKERS.items():
+        c = TwoPhaseSys(5).checker().symmetry().spawn_mp_bfs(processes=n)
+        assert c.unique_state_count() == expected, (n, c.unique_state_count())
+        assert sorted(c.discoveries()) == [
+            "abort agreement", "commit agreement",
+        ]
+
+
+def test_mp_symmetry_paths_are_original_state_traces():
+    """The search continues with ORIGINAL states (the ``dfs.rs:394-483``
+    regression subtlety): parent pointers chain real fingerprints, so
+    discovery paths re-execute without a class-matching walk and their
+    final states witness the property."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    m = TwoPhaseSys(5)
+    c = m.checker().symmetry().spawn_mp_bfs(processes=2)
+    for name, path in c.discoveries().items():
+        prop = m.property_by_name(name)
+        assert prop.condition(m, path.final_state())
+        assert len(path.actions()) >= 1

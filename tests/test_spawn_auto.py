@@ -1,0 +1,109 @@
+"""``spawn_auto()`` — engine selection by measured space size.
+
+The small-space footgun (bench r4): the device engine's fixed per-run
+cost dominates below ~1e5 states, where CPU BFS is 8-100x faster
+(lin-reg-2's 544-state space: 927 states/s on a v5e vs 7.4k/s on one CPU
+core).  ``spawn_auto`` runs a time-bounded CPU probe first; a space that
+exhausts within the budget returns the finished CPU checker, a bigger
+one escalates to the device engine.  No reference counterpart (the
+reference has one strategy family); the CLI shape being served is
+``examples/paxos.rs:314-395``'s check commands.
+"""
+
+from stateright_tpu.checker.bfs import BfsChecker
+from stateright_tpu.checker.dfs import DfsChecker
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.parallel.wavefront import TpuChecker
+
+
+def test_small_space_finishes_on_cpu():
+    """A space the CPU probe exhausts is answered by the probe itself —
+    the device is never touched (no compile cost, no tunnel)."""
+    c = TwoPhaseSys(3).checker().spawn_auto()
+    assert isinstance(c, BfsChecker)
+    assert c.is_done() and not c.timed_out
+    assert c.unique_state_count() == 288  # examples/2pc.rs:128
+    assert set(c.discoveries()) == {"abort agreement", "commit agreement"}
+
+
+def test_large_space_escalates_to_device_engine():
+    """A probe that times out means the space outgrew its CPU budget:
+    the check restarts on the device engine and completes there."""
+    c = (
+        TwoPhaseSys(5)
+        .checker()
+        .spawn_auto(probe_secs=0.01, sync=True, capacity=1 << 17)
+    )
+    assert isinstance(c, TpuChecker)
+    assert c.unique_state_count() == 8832  # examples/2pc.rs:133
+    assert set(c.discoveries()) == {"abort agreement", "commit agreement"}
+
+
+def test_no_tensor_twin_checks_on_cpu():
+    """Object-form-only models (no tensor twin) go straight to CPU."""
+    from stateright_tpu.core import Model, Property
+
+    class Toggle(Model):
+        def init_states(self):
+            return [0]
+
+        def actions(self, state):
+            return ["flip"]
+
+        def next_state(self, state, action):
+            return 1 - state
+
+        def properties(self):
+            return [Property.sometimes("one", lambda m, s: s == 1)]
+
+    c = Toggle().checker().spawn_auto()
+    assert isinstance(c, BfsChecker)
+    assert c.unique_state_count() == 2
+    assert set(c.discoveries()) == {"one"}
+
+
+def test_visitor_forces_cpu():
+    """Visitors need host state materialization, which the device engines
+    reject — auto selection respects that outright."""
+    seen = []
+    c = (
+        TwoPhaseSys(3)
+        .checker()
+        .visitor(lambda model, path: seen.append(path.final_state()))
+        .spawn_auto()
+    )
+    assert isinstance(c, BfsChecker)
+    c.join()
+    assert len(seen) == 288
+
+
+def test_symmetry_probe_uses_dfs():
+    """With ``symmetry()`` the CPU probe is DFS (the host engine that
+    supports representative dedup, as in the reference where symmetry is
+    DFS-only) and pins the reduced count."""
+    c = TwoPhaseSys(5).checker().symmetry().spawn_auto(probe_secs=30.0)
+    assert isinstance(c, DfsChecker)
+    assert c.unique_state_count() == 665  # examples/2pc.rs:138
+
+
+def test_tiny_user_timeout_stays_on_cpu():
+    """A user timeout within the probe budget means the whole run fits in
+    the probe: no point paying device setup for a run this short."""
+    c = TwoPhaseSys(3).checker().timeout(0.5).spawn_auto(probe_secs=2.0)
+    assert isinstance(c, BfsChecker)
+    c.join()
+    assert c.unique_state_count() == 288
+
+
+def test_timed_out_flag_distinguishes_deadline_from_completion():
+    """``timed_out`` is the probe's decision signal: set only by the
+    deadline, not by finishing or reaching target_states."""
+    done = TwoPhaseSys(3).checker().spawn_bfs().join()
+    assert not done.timed_out
+    capped = (
+        TwoPhaseSys(5).checker().target_states(100).spawn_bfs().join()
+    )
+    assert not capped.timed_out
+    cut = TwoPhaseSys(6).checker().timeout(0.01).spawn_bfs().join()
+    assert cut.timed_out
+    assert cut.unique_state_count() < 30_000  # stopped well short
