@@ -100,6 +100,18 @@ class RegisterClient(Actor):
     #: accepts ``put_fail``
     put_reply_kinds = ("put_ok",)
 
+    @staticmethod
+    def put_value(index: int, server_count: int, op_index: int) -> str:
+        """Value the ``op_index``-th put (0-based) of client ``index``
+        writes: 'A'.. for the first put, 'Z'-.. for every later one
+        (reference ``register.rs:140,178``).  The single source of the
+        scheme — the actor compiler derives per-client write scripts from
+        it, so the real workload and the compiled history codec cannot
+        drift."""
+        if op_index == 0:
+            return chr(ord("A") + index - server_count)
+        return chr(ord("Z") - (index - server_count))
+
     def on_start(self, id: Id, out: Out):
         index = int(id)
         if index < self.server_count:
@@ -109,7 +121,7 @@ class RegisterClient(Actor):
         if self.put_count == 0:
             return RegisterClientState(awaiting=None, op_count=0)
         req_id = index
-        value = chr(ord("A") + index - self.server_count)
+        value = self.put_value(index, self.server_count, 0)
         out.send(Id(index % self.server_count), Put(req_id, value))
         return RegisterClientState(awaiting=req_id, op_count=1)
 
@@ -121,7 +133,9 @@ class RegisterClient(Actor):
         if kind in self.put_reply_kinds and msg[1] == state.awaiting:
             req_id = (state.op_count + 1) * index
             if state.op_count < self.put_count:
-                value = chr(ord("Z") - (index - self.server_count))
+                value = self.put_value(
+                    index, self.server_count, state.op_count
+                )
                 out.send(
                     Id((index + state.op_count) % self.server_count),
                     Put(req_id, value),

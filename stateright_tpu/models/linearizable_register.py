@@ -181,8 +181,8 @@ class AbdServer(Actor):
 class AbdModel(TensorBackedModel, ActorModel):
     """ActorModel with a mechanically compiled device twin
     (``parallel/actor_compiler.py``): eligible configurations (unordered
-    non-duplicating network, ``put_count=1`` clients) run on the TPU
-    wavefront engine with no protocol-specific device code."""
+    non-duplicating or ordered network; any uniform ``put_count``) run on
+    the TPU wavefront engine with no protocol-specific device code."""
 
     def tensor_model(self):
         from ..actor.network import (
@@ -197,22 +197,28 @@ class AbdModel(TensorBackedModel, ActorModel):
         ):
             # the state_bound below assumes each message is delivered at most
             # once; under a duplicating network a redelivered put restarts a
-            # write round, the clock exceeds C in REAL runs (the space is
-            # unbounded), and the bound would poison reachable transitions
+            # write round, the clock exceeds the write total in REAL runs
+            # (the space is unbounded), and the bound would poison reachable
+            # transitions
             return None
 
-        C = sum(isinstance(a, RegisterClient) for a in self.actors)
+        # total write ops: each bumps the ABD logical clock at most once
+        W = sum(
+            a.put_count
+            for a in self.actors
+            if isinstance(a, RegisterClient)
+        )
 
         def state_bound(i, s):
-            # ABD sequencers are (logical clock, server id); each of the C
-            # writes bumps the clock by at most one, so clock <= C in any
+            # ABD sequencers are (logical clock, server id); each of the W
+            # writes bumps the clock by at most one, so clock <= W in any
             # real run — the bound only cuts closure over-approximation.
-            return not isinstance(s, AbdState) or s.seq[0] <= C
+            return not isinstance(s, AbdState) or s.seq[0] <= W
 
         def env_bound(env):
             m = env.msg
             if m[0] == "internal" and m[1][0] in ("ack_query", "record"):
-                return m[1][2][0] <= C
+                return m[1][2][0] <= W
             return True
 
         try:
@@ -224,9 +230,13 @@ class AbdModel(TensorBackedModel, ActorModel):
 
 
 def abd_model(
-    client_count: int, server_count: int = 2, network: Optional[Network] = None
+    client_count: int,
+    server_count: int = 2,
+    network: Optional[Network] = None,
+    put_count: int = 1,
 ) -> ActorModel:
-    """Build the checked system (reference ``linearizable-register.rs:195-230``)."""
+    """Build the checked system (reference ``linearizable-register.rs:195-230``;
+    ``put_count`` as in reference ``register.rs:96,178-186``)."""
     if network is None:
         network = Network.new_unordered_nonduplicating()
     m = AbdModel(
@@ -235,7 +245,7 @@ def abd_model(
     for i in range(server_count):
         m.actor(AbdServer(peers=model_peers(i, server_count)))
     for _ in range(client_count):
-        m.actor(RegisterClient(put_count=1, server_count=server_count))
+        m.actor(RegisterClient(put_count=put_count, server_count=server_count))
     m.init_network_(network)
     m.property(
         Expectation.ALWAYS,

@@ -61,7 +61,10 @@ class SingleCopyModel(TensorBackedModel, ActorModel):
 
 
 def single_copy_model(
-    client_count: int, server_count: int = 1, network: Optional[Network] = None
+    client_count: int,
+    server_count: int = 1,
+    network: Optional[Network] = None,
+    put_count: int = 1,
 ) -> ActorModel:
     if network is None:
         network = Network.new_unordered_nonduplicating()
@@ -71,7 +74,7 @@ def single_copy_model(
     for _ in range(server_count):
         m.actor(SingleCopyServer())
     for _ in range(client_count):
-        m.actor(RegisterClient(put_count=1, server_count=server_count))
+        m.actor(RegisterClient(put_count=put_count, server_count=server_count))
     m.init_network_(network)
     m.property(
         Expectation.ALWAYS,

@@ -79,6 +79,7 @@ from .history_tensor import (
     PHASE_R_INFLIGHT,
     PHASE_W_INFLIGHT,
     LinHistoryCodec,
+    MultiOpLinHistoryCodec,
 )
 from .tensor_model import BitPacker, TensorModel
 
@@ -136,6 +137,9 @@ class CompiledActorTensor(TensorModel):
     ):
         self.model = model
         self._check_fragment()
+        # multi-op register workload (put_count >= 2): per-thread op-index
+        # history fields + the MultiOpLinHistoryCodec table strategy
+        self._multi = not self.general and self._put_count > 1
         self._state_bound = state_bound or (lambda i, s: True)
         self._env_bound = env_bound or (lambda e: True)
         self._caps = (max_states_per_actor, max_envelopes)
@@ -153,24 +157,49 @@ class CompiledActorTensor(TensorModel):
             ]
             self.C = len(self.clients)
             values = [
-                chr(ord("A") + int(t) - model.actors[t].server_count)
+                RegisterClient.put_value(
+                    int(t), model.actors[t].server_count, 0
+                )
                 for t in self.clients
             ]
-            self.hist = LinHistoryCodec(
-                self.clients,
-                values,
-                # the write-once spec models the unset register as None; the
-                # wire protocol's null stays NULL_VALUE (translated at the
-                # get_ok boundary, mirroring the WO record_returns recorder)
-                None if self._wo else NULL_VALUE,
-                tester_factory=lambda: type(model.init_history)(
-                    model.init_history.init_ref_obj
-                ),
-                max_states=max_history_states,
-                write_rets=(("write_ok",), ("write_fail",))
-                if self._wo
-                else (("write_ok",),),
+            tester_factory = lambda: type(model.init_history)(
+                model.init_history.init_ref_obj
             )
+            if self._put_count > 1:
+                # per-client write scripts, from the SAME value scheme the
+                # real workload uses (RegisterClient.put_value) so the
+                # codec cannot drift from the actors
+                scripts = [
+                    [
+                        RegisterClient.put_value(
+                            int(t), model.actors[t].server_count, k
+                        )
+                        for k in range(self._put_count)
+                    ]
+                    for t in self.clients
+                ]
+                self.hist = MultiOpLinHistoryCodec(
+                    self.clients,
+                    scripts,
+                    NULL_VALUE,
+                    tester_factory=tester_factory,
+                    max_states=max_history_states,
+                )
+            else:
+                self.hist = LinHistoryCodec(
+                    self.clients,
+                    values,
+                    # the write-once spec models the unset register as None;
+                    # the wire protocol's null stays NULL_VALUE (translated
+                    # at the get_ok boundary, mirroring the WO
+                    # record_returns recorder)
+                    None if self._wo else NULL_VALUE,
+                    tester_factory=tester_factory,
+                    max_states=max_history_states,
+                    write_rets=(("write_ok",), ("write_fail",))
+                    if self._wo
+                    else (("write_ok",),),
+                )
 
         self._closure()
         self._tabulate_properties()
@@ -192,13 +221,19 @@ class CompiledActorTensor(TensorModel):
             bits = max(1, int(np.ceil(np.log2(max(2, len(self._states[i]))))))
             fields.append((f"a{i}", bits))
         for c in range(self.C):
-            fields += [
-                (f"h{c}_phase", 2),
-                (f"h{c}_snap", max(1, 2 * (self.C - 1))),
-                (f"h{c}_rval", 3),
-            ]
-            if self.hist.wfail_bits:
-                fields.append((f"h{c}_wfail", 1))
+            if self._multi:
+                fields.append((f"h{c}_phase", self.hist.phase_bits))
+                for m in range(self.hist.K):
+                    fields.append((f"h{c}_snap{m}", self.hist.snap_bits))
+                fields.append((f"h{c}_rval", self.hist.rval_bits))
+            else:
+                fields += [
+                    (f"h{c}_phase", 2),
+                    (f"h{c}_snap", max(1, 2 * (self.C - 1))),
+                    (f"h{c}_rval", 3),
+                ]
+                if self.hist.wfail_bits:
+                    fields.append((f"h{c}_wfail", 1))
         if self._has_timers:
             fields.append(("timers", self.n_actors))
         fields.append(("poison", 1))
@@ -255,6 +290,7 @@ class CompiledActorTensor(TensorModel):
 
             self.general = True
             self._wo = False
+            self._put_count = 0
             bad = sorted(
                 p.name
                 for p in m.properties()
@@ -315,9 +351,21 @@ class CompiledActorTensor(TensorModel):
                 "record_returns/record_invocations"
             )
         clients = [a for a in m.actors if isinstance(a, RegisterClient)]
-        if not clients or any(c.put_count != 1 for c in clients):
+        if not clients or any(c.put_count < 1 for c in clients):
             raise CompileError(
-                "workload must be RegisterClient actors with put_count=1"
+                "workload must be RegisterClient actors with put_count >= 1"
+            )
+        put_counts = {c.put_count for c in clients}
+        if len(put_counts) != 1:
+            raise CompileError(
+                f"per-client put_counts must be uniform (got {sorted(put_counts)})"
+            )
+        self._put_count = put_counts.pop()
+        if self._wo and self._put_count != 1:
+            raise CompileError(
+                "write-once workloads compile with put_count=1 only (a "
+                "failed write changes which op takes effect; the multi-op "
+                "codec models write_ok returns)"
             )
         if any(
             isinstance(a, RegisterClient)
@@ -359,9 +407,24 @@ class CompiledActorTensor(TensorModel):
                 return -1, False
             code = len(self._states[i])
             if code >= max_s:
+                from ..actor.ordered_reliable_link import LinkState
+
+                hint = ""
+                if isinstance(s, LinkState):
+                    # name the actual unbounded fields instead of leaving
+                    # the user to diff 200k closure states: the ORL
+                    # wrapper's sequencers grow forever unless capped
+                    hint = (
+                        "; this is an OrderedReliableLink wrapper state — "
+                        "next_send_seq/msgs_pending_ack/last_delivered_seqs "
+                        "grow without bound when the wrapped actor keeps "
+                        "sending; cap them with state_bound (worked recipe: "
+                        "docs/compiling-actor-systems.md, 'Compiling "
+                        "ORL-wrapped systems')"
+                    )
                 raise CompileError(
                     f"actor {i} state universe exceeded {max_s}; "
-                    "tighten state_bound"
+                    "tighten state_bound" + hint
                 )
             self._states[i].append(s)
             self._state_code[i][s] = code
@@ -582,10 +645,20 @@ class CompiledActorTensor(TensorModel):
             else:
                 assert isinstance(c, Send)
                 snd = Envelope(src=Id(i), dst=c.dst, msg=c.msg)
-                if not self.general and snd.msg[0] == "put":
+                if (
+                    not self.general
+                    and snd.msg[0] == "put"
+                    and self._put_count == 1
+                ):
+                    # put_count=1 histories invoke every write at start; a
+                    # mid-run put means the workload isn't the declared
+                    # script.  Multi-op workloads (put_count >= 2) send
+                    # their later puts mid-run by design — the multi-op
+                    # codec's phase indices model exactly that.
                     raise CompileError(
-                        "mid-run put invocations are not compilable "
-                        "(put_count must be 1)"
+                        "a client declaring put_count=1 sent a put mid-run: "
+                        "its sends do not match the declared one-write "
+                        "script (custom client? declare the real put_count)"
                     )
                 sc, ok = add_env(snd)
                 poison |= not ok
@@ -877,7 +950,15 @@ class CompiledActorTensor(TensorModel):
                     "(state_bound too tight, or a closure gap)"
                 )
             vals[f"a{i}"] = code
-        if not self.general:
+        if self._multi:
+            for c, (phase, snaps, rval) in enumerate(
+                self.hist.fields_of_tester(st.history)
+            ):
+                vals[f"h{c}_phase"] = phase
+                for m in range(self.hist.K):
+                    vals[f"h{c}_snap{m}"] = snaps[m]
+                vals[f"h{c}_rval"] = rval
+        elif not self.general:
             for c, (phase, snap, rval, wfail) in enumerate(
                 self.hist.fields_of_tester(st.history)
             ):
@@ -916,6 +997,19 @@ class CompiledActorTensor(TensorModel):
         )
         if self.general:
             tester = None
+        elif self._multi:
+            tester = self.hist.tester_of_fields(
+                [
+                    (
+                        d[f"h{c}_phase"],
+                        tuple(
+                            d[f"h{c}_snap{m}"] for m in range(self.hist.K)
+                        ),
+                        d[f"h{c}_rval"],
+                    )
+                    for c in range(self.C)
+                ]
+            )
         else:
             tester = self.hist.tester_of_fields(
                 [
@@ -1125,7 +1219,61 @@ class CompiledActorTensor(TensorModel):
             out = pk.set(out, "timers", tnew.astype(u64))
 
         # -- history updates -------------------------------------------------
-        if self.C:
+        if self.C and self._multi:
+            # multi-op workload (put_count >= 2): phase = 2*completed +
+            # in_flight.  A put_ok return invokes the next op in the same
+            # transition (+2); the final get_ok return just completes (+1).
+            # The newly-invoked op's snapshot (peers' completed counts) is
+            # scattered into the snap field of the op it belongs to —
+            # writes 2..K and the read all carry real-time snapshots here,
+            # unlike the K=1 layout where only the read's is non-trivial.
+            K = self.hist.K
+            eb = self.hist.snap_entry_bits
+            kind = cst["env_kind"][ecode]  # [B, NS]
+            ci = self._client_of_dev()[jnp.clip(dst, 0, self.n_actors - 1)]
+            is_ret_w = valid & (kind == _K_PUT_OK) & (ci >= 0)
+            is_ret_r = valid & (kind == _K_GET_OK) & (ci >= 0)
+            rv = cst["env_val"][ecode]
+            phases = jnp.stack(
+                [
+                    pk.get(rows, f"h{c}_phase").astype(i32)
+                    for c in range(self.C)
+                ],
+                -1,
+            )  # [B, C]
+            comp = phases >> 1  # completed ops per thread (stored states)
+            for c in range(self.C):
+                m_w = is_ret_w & (ci == c)
+                m_r = is_ret_r & (ci == c)
+                cur_ph = pk.get(rows, f"h{c}_phase").astype(i32)[:, None]
+                new_ph = jnp.where(
+                    m_w, cur_ph + 2, jnp.where(m_r, cur_ph + 1, cur_ph)
+                )
+                out = pk.set(out, f"h{c}_phase", new_ph.astype(u64))
+                cur_comp = cur_ph >> 1  # [B, 1]
+                snap = jnp.zeros((B, NS), i32)
+                for j in range(self.C):
+                    if j == c:
+                        continue
+                    slot = self.hist._snap_slot(c, j)
+                    snap = snap | (comp[:, j : j + 1] << (eb * slot))
+                for m in range(K):
+                    sel = m_w & (cur_comp == m)
+                    cur_snap = pk.get(rows, f"h{c}_snap{m}").astype(i32)[
+                        :, None
+                    ]
+                    out = pk.set(
+                        out,
+                        f"h{c}_snap{m}",
+                        jnp.where(sel, snap, cur_snap).astype(u64),
+                    )
+                cur_rv = pk.get(rows, f"h{c}_rval").astype(i32)[:, None]
+                out = pk.set(
+                    out,
+                    f"h{c}_rval",
+                    jnp.where(m_r, rv, cur_rv).astype(u64),
+                )
+        elif self.C:
             kind = cst["env_kind"][ecode]  # [B, NS]
             ci = self._client_of_dev()[jnp.clip(dst, 0, self.n_actors - 1)]
             is_ret_w = (
@@ -1377,28 +1525,48 @@ class CompiledActorTensor(TensorModel):
             [pk.get(rows, f"h{c}_phase").astype(i32) for c in range(self.C)],
             -1,
         )
-        snaps = jnp.stack(
-            [pk.get(rows, f"h{c}_snap").astype(i32) for c in range(self.C)],
-            -1,
-        )
         rvals = jnp.stack(
             [pk.get(rows, f"h{c}_rval").astype(i32) for c in range(self.C)],
             -1,
         )
-        wfails = None
-        if self.hist.wfail_bits:
-            wfails = jnp.stack(
+        if self._multi:
+            snaps = jnp.stack(
                 [
-                    pk.get(rows, f"h{c}_wfail").astype(i32)
+                    jnp.stack(
+                        [
+                            pk.get(rows, f"h{c}_snap{m}").astype(i32)
+                            for m in range(self.hist.K)
+                        ],
+                        -1,
+                    )
+                    for c in range(self.C)
+                ],
+                -2,
+            )  # [B, C, K]
+            keys = self.hist.device_key(phases, snaps, rvals)
+            linearizable = self.hist.device_lookup(keys)
+        else:
+            snaps = jnp.stack(
+                [
+                    pk.get(rows, f"h{c}_snap").astype(i32)
                     for c in range(self.C)
                 ],
                 -1,
             )
-        if self.hist.strategy == "closure":
-            linearizable = self.hist.device_verdict(phases, snaps, rvals)
-        else:
-            keys = self.hist.device_key(phases, snaps, rvals, wfails)
-            linearizable = self.hist.device_lookup(keys)
+            wfails = None
+            if self.hist.wfail_bits:
+                wfails = jnp.stack(
+                    [
+                        pk.get(rows, f"h{c}_wfail").astype(i32)
+                        for c in range(self.C)
+                    ],
+                    -1,
+                )
+            if self.hist.strategy == "closure":
+                linearizable = self.hist.device_verdict(phases, snaps, rvals)
+            else:
+                keys = self.hist.device_key(phases, snaps, rvals, wfails)
+                linearizable = self.hist.device_lookup(keys)
 
         slots = rows[:, self.pw :]
         occ = slots != u64(SLOT_EMPTY)

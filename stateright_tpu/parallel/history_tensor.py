@@ -78,7 +78,54 @@ MAX_THREADS = 4
 MAX_THREADS_CLOSURE = 7
 
 
-class LinHistoryCodec:
+class _TableCodecBase:
+    """Helpers shared by both history codecs: value/thread/slot coding,
+    per-thread key packing, and the sorted-table device lookup.  One
+    definition so the two codecs cannot drift (the lookup's lazy
+    ``ensure_table`` guard in particular)."""
+
+    def _thread_index(self, t) -> int:
+        return self.threads.index(int(t))
+
+    def _snap_slot(self, i: int, j: int) -> int:
+        """Bit-slot of peer ``j`` inside thread ``i``'s snapshot field
+        (peers are numbered skipping ``i`` itself)."""
+        return j if j < i else j - 1
+
+    def _value_code(self, v) -> int:
+        return 0 if v == self.null_value else self.values.index(v) + 1
+
+    def _value_decode(self, code: int):
+        return self.null_value if code == 0 else self.values[code - 1]
+
+    def key_of_fields(self, fields: list) -> int:
+        """Per-thread field tuples -> packed joint key."""
+        key = 0
+        for i, f in enumerate(fields):
+            key |= self.pack_thread(*f) << (i * self.thread_bits)
+        return key
+
+    def ensure_table(self) -> None:
+        if not self._table_built:
+            self._enumerate(self._max_states)
+            self._table_built = True
+
+    def device_lookup(self, keys):
+        """Vectorized verdict lookup: binary search over the sorted key
+        table.  Keys absent from the table (combinations no interleaving
+        can produce) return False."""
+        import jax.numpy as jnp
+
+        self.ensure_table()
+        tk = jnp.asarray(self.table_keys)
+        ok = jnp.asarray(self.table_ok)
+        idx = jnp.clip(
+            jnp.searchsorted(tk, keys, side="left"), 0, tk.shape[0] - 1
+        )
+        return ok[idx] & (tk[idx] == keys)
+
+
+class LinHistoryCodec(_TableCodecBase):
     """Host+device codec for the joint linearizability-tester state of a
     ``put_count=1`` register workload.
 
@@ -142,12 +189,8 @@ class LinHistoryCodec:
             | (wfail << (self.phase_bits + self.snap_bits + self.rval_bits))
         )
 
-    def key_of_fields(self, fields: list) -> int:
-        """``fields[i] = (phase, snap, rval, wfail)`` per thread -> key."""
-        key = 0
-        for i, f in enumerate(fields):
-            key |= self.pack_thread(*f) << (i * self.thread_bits)
-        return key
+    # key_of_fields from _TableCodecBase:
+    # ``fields[i] = (phase, snap, rval, wfail)`` per thread -> key
 
     # -- tester <-> fields ---------------------------------------------------
 
@@ -229,26 +272,7 @@ class LinHistoryCodec:
             tester.init_ref_obj, history, in_flight, valid=True
         )
 
-    def _thread_index(self, t) -> int:
-        return self.threads.index(int(t))
-
-    def _snap_slot(self, i: int, j: int) -> int:
-        """Bit-slot of peer ``j`` inside thread ``i``'s snapshot field
-        (peers are numbered skipping ``i`` itself)."""
-        return j if j < i else j - 1
-
-    def _value_code(self, v) -> int:
-        return 0 if v == self.null_value else self.values.index(v) + 1
-
-    def _value_decode(self, code: int):
-        return self.null_value if code == 0 else self.values[code - 1]
-
     # -- enumeration ---------------------------------------------------------
-
-    def ensure_table(self) -> None:
-        if not self._table_built:
-            self._enumerate(self._max_states)
-            self._table_built = True
 
     def _enumerate(self, max_states: int) -> None:
         """BFS over invoke/return events; superset of protocol-reachable
@@ -318,20 +342,6 @@ class LinHistoryCodec:
             key = key | (word.astype(jnp.int64) << (i * self.thread_bits))
         return key
 
-    def device_lookup(self, keys):
-        """Vectorized verdict lookup: binary search over the sorted key
-        table.  Keys absent from the table (combinations no interleaving can
-        produce) return False."""
-        import jax.numpy as jnp
-
-        self.ensure_table()
-        tk = jnp.asarray(self.table_keys)
-        ok = jnp.asarray(self.table_ok)
-        idx = jnp.clip(
-            jnp.searchsorted(tk, keys, side="left"), 0, tk.shape[0] - 1
-        )
-        return ok[idx] & (tk[idx] == keys)
-
     def device_verdict(self, phases, snaps, rvals):
         """Closure-strategy verdict, computed per state on device.
 
@@ -362,6 +372,257 @@ class LinHistoryCodec:
                 slot = self._snap_slot(i, j)
                 s = s.at[..., i, j].set((snaps[..., i] >> (2 * slot)) & 3)
         return closure_verdict(done, s, rvals)
+
+
+class MultiOpLinHistoryCodec(_TableCodecBase):
+    """Host+device codec for ``put_count >= 2`` register workloads
+    (reference ``src/actor/register.rs:96,178-186``: each client performs
+    ``put_count`` writes then one read, every op invoked in the same
+    transition that returns its predecessor).
+
+    Generalizes :class:`LinHistoryCodec`'s 3-phase put→get script to
+    per-thread op indices.  Per-thread packed fields:
+
+     - ``phase`` = ``2*completed + in_flight``: ``completed`` ops have
+       returned (0..K+1) and the next op is in flight or not.  Stored
+       model states always have an op in flight until the read returns
+       (invocation happens in the return transition), so stored phases
+       are odd, plus the final ``2*(K+1)``; even intermediates appear
+       only inside the event enumeration.
+     - ``snap[m]`` for ``m`` in ``0..K-1``: the invocation snapshot of op
+       ``m+2`` (op 1 is invoked at start with an empty snapshot) — per
+       peer, how many ops it had completed, ``ceil(log2(K+2))`` bits each.
+       Unlike the K=1 codec, WRITE invocations now carry non-trivial
+       snapshots (the tester's real-time constraint applies to them too),
+       which is exactly what the single-snapshot layout cannot express.
+     - ``rval``: index of the value the read returned (0 = null).
+
+    Only the **table strategy** exists here: every reachable joint tester
+    state is enumerated host-side through the real
+    :class:`~stateright_tpu.semantics.LinearizabilityTester` and the
+    exact ``is_consistent()`` verdict shipped as ``(sorted keys,
+    verdicts)``.  The closure strategy's acyclicity reduction is K=1-only
+    (its exactness argument needs one write per thread)."""
+
+    def __init__(
+        self,
+        threads: list,
+        scripts: list,
+        null_value,
+        tester_factory=None,
+        max_states: int = 2_000_000,
+    ):
+        self.threads = [int(t) for t in threads]
+        self.scripts = [list(s) for s in scripts]  # per-thread write values
+        if not self.scripts or any(len(s) < 1 for s in self.scripts):
+            raise ValueError("every thread needs at least one write")
+        self.null_value = null_value
+        self.C = C = len(threads)
+        self.K = K = max(len(s) for s in self.scripts)
+        if any(len(s) != K for s in self.scripts):
+            raise ValueError("per-thread put_counts must be uniform")
+        # distinct written values, first-appearance order, code 1..V
+        self.values: list = []
+        for s in self.scripts:
+            for v in s:
+                if v not in self.values:
+                    self.values.append(v)
+        self.phase_bits = max(1, int(np.ceil(np.log2(2 * (K + 1) + 1))))
+        self.snap_entry_bits = max(1, int(np.ceil(np.log2(K + 2))))
+        self.snap_bits = self.snap_entry_bits * max(1, C - 1)
+        self.rval_bits = max(3, int(np.ceil(np.log2(len(self.values) + 2))))
+        self.thread_bits = self.phase_bits + K * self.snap_bits + self.rval_bits
+        if C * self.thread_bits > 62:
+            raise ValueError(
+                f"joint key needs {C * self.thread_bits} bits (> 62): "
+                f"too many clients/ops for the table strategy "
+                f"(C={C}, put_count={K})"
+            )
+        self.strategy = "table"
+        self.wfail_bits = 0  # write-once workloads are K=1-only
+        if tester_factory is None:
+            tester_factory = lambda: LinearizabilityTester(Register(null_value))
+        self._tester_factory = tester_factory
+        self._max_states = max_states
+        self._table_built = False
+        self.ensure_table()
+
+    # -- scripts -------------------------------------------------------------
+
+    def _ops(self, i: int) -> list:
+        """Thread ``i``'s full op script: K writes then the read."""
+        return [write(v) for v in self.scripts[i]] + [READ]
+
+    # -- packing -------------------------------------------------------------
+
+    def pack_thread(self, phase: int, snaps: tuple, rval: int) -> int:
+        word = phase
+        off = self.phase_bits
+        for m in range(self.K):
+            word |= (snaps[m] if m < len(snaps) else 0) << off
+            off += self.snap_bits
+        word |= rval << off
+        return word
+
+    # key_of_fields from _TableCodecBase:
+    # ``fields[i] = (phase, snaps_tuple, rval)`` per thread -> key
+
+    def _snap_of(self, i: int, snap_src) -> int:
+        snap = 0
+        for peer, idx in snap_src:
+            j = self._thread_index(peer)
+            snap |= (idx + 1) << (
+                self.snap_entry_bits * self._snap_slot(i, j)
+            )
+        return snap
+
+    # -- tester <-> fields ---------------------------------------------------
+
+    def fields_of_tester(self, tester: LinearizabilityTester) -> list:
+        if not tester.valid:
+            raise ValueError("invalid (protocol-misuse) tester state")
+        fields = []
+        for i, t in enumerate(self.threads):
+            ops = self._ops(i)
+            completed = tester.history_by_thread.get(t, ())
+            in_flight = tester.in_flight_by_thread.get(t)
+            j = len(completed)
+            snaps = [0] * self.K
+            rval = 0
+            for m, (snap_src, op, ret) in enumerate(completed):
+                if op != ops[m]:
+                    raise ValueError(f"thread {t}: op {m} mismatch")
+                if m >= 1:
+                    snaps[m - 1] = self._snap_of(i, snap_src)
+                if op == READ:
+                    if ret[0] != "read_ok":
+                        raise ValueError(f"thread {t}: bad read return")
+                    rval = self._value_code(ret[1])
+                elif ret != ("write_ok",):
+                    raise ValueError(f"thread {t}: bad write return")
+            if in_flight is not None:
+                if j >= len(ops) or in_flight[1] != ops[j]:
+                    raise ValueError(f"thread {t}: unexpected in-flight op")
+                if j >= 1:
+                    snaps[j - 1] = self._snap_of(i, in_flight[0])
+                phase = 2 * j + 1
+            else:
+                phase = 2 * j
+            fields.append((phase, tuple(snaps), rval))
+        return fields
+
+    def tester_of_fields(self, fields: list) -> LinearizabilityTester:
+        history: dict = {}
+        in_flight: dict = {}
+        for i, (phase, snaps, rval) in enumerate(fields):
+            t = self.threads[i]
+            ops = self._ops(i)
+            j, fl = phase >> 1, phase & 1
+
+            def snap_t(m):  # snapshot tuple of op m (0-based); m>=1 stored
+                if m == 0:
+                    return ()
+                raw = snaps[m - 1]
+                return tuple(
+                    sorted(
+                        (
+                            self.threads[p],
+                            (
+                                (raw >> (self.snap_entry_bits
+                                         * self._snap_slot(i, p)))
+                                & ((1 << self.snap_entry_bits) - 1)
+                            )
+                            - 1,
+                        )
+                        for p in range(self.C)
+                        if p != i
+                        and (raw >> (self.snap_entry_bits
+                                     * self._snap_slot(i, p)))
+                        & ((1 << self.snap_entry_bits) - 1)
+                    )
+                )
+
+            hist = []
+            for m in range(j):
+                op = ops[m]
+                ret = (
+                    ("read_ok", self._value_decode(rval))
+                    if op == READ
+                    else ("write_ok",)
+                )
+                hist.append((snap_t(m), op, ret))
+            history[t] = tuple(hist)
+            if fl:
+                in_flight[t] = (snap_t(j), ops[j])
+        tester = self._tester_factory()
+        return type(tester)(
+            tester.init_ref_obj, history, in_flight, valid=True
+        )
+
+    # -- enumeration ---------------------------------------------------------
+
+    def _enumerate(self, max_states: int) -> None:
+        init = self._tester_factory()
+        for i, t in enumerate(self.threads):
+            init = init.on_invoke(t, write(self.scripts[i][0]))
+        seen = {init}
+        queue = deque([init])
+        read_rets = [("read_ok", self.null_value)] + [
+            ("read_ok", v) for v in self.values
+        ]
+        while queue:
+            tester = queue.popleft()
+            if len(seen) > max_states:
+                raise RuntimeError(
+                    f"joint tester enumeration exceeded {max_states} states"
+                )
+            for i, t in enumerate(self.threads):
+                ops = self._ops(i)
+                in_flight = tester.in_flight_by_thread.get(t)
+                completed = tester.history_by_thread.get(t, ())
+                if in_flight is not None:
+                    rets = (
+                        read_rets if in_flight[1] == READ else [("write_ok",)]
+                    )
+                    succs = [tester.on_return(t, r) for r in rets]
+                elif len(completed) < len(ops):
+                    succs = [tester.on_invoke(t, ops[len(completed)])]
+                else:
+                    continue
+                for s in succs:
+                    if s not in seen:
+                        seen.add(s)
+                        queue.append(s)
+        keys = np.empty(len(seen), np.int64)
+        oks = np.empty(len(seen), bool)
+        for n, tester in enumerate(seen):
+            keys[n] = self.key_of_fields(self.fields_of_tester(tester))
+            oks[n] = tester.is_consistent()
+        order = np.argsort(keys)
+        self.table_keys = keys[order]
+        self.table_ok = oks[order]
+
+    # -- device --------------------------------------------------------------
+
+    def device_key(self, phases, snaps, rvals, wfails=None):
+        """``phases``/``rvals``: [..., C] int32; ``snaps``: [..., C, K]
+        int32 — pack into int64 keys mirroring :meth:`key_of_fields`."""
+        import jax.numpy as jnp
+
+        key = jnp.zeros(phases.shape[:-1], jnp.int64)
+        for i in range(self.C):
+            word = phases[..., i].astype(jnp.int64)
+            off = self.phase_bits
+            for m in range(self.K):
+                word = word | (
+                    snaps[..., i, m].astype(jnp.int64) << off
+                )
+                off += self.snap_bits
+            word = word | (rvals[..., i].astype(jnp.int64) << off)
+            key = key | (word << (i * self.thread_bits))
+        return key
+
+    # device_lookup from _TableCodecBase (with the lazy ensure_table guard)
 
 
 def closure_verdict(done, s, rvals):

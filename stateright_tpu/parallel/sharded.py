@@ -517,12 +517,15 @@ class ShardedTpuChecker(WavefrontChecker):
 
     @staticmethod
     def _require_single_controller(what: str) -> None:
-        """Checkpoint/stop/resume/growth are single-controller only for now:
-        the sharded carry is not addressable across hosts, and per-process
+        """Checkpoint/stop/resume are single-controller only for now: the
+        full sharded carry is not addressable across hosts, and per-process
         host events (``_stop``, ``_ckpt_req``) would break the lockstep
         invariant that every controller issues the same collectives.  Raised
         from the CALLER-facing entry points so a multi-controller user gets
-        the error, not a dead run thread."""
+        the error, not a dead run thread.  (Mid-run GROWTH is *not* fenced:
+        its trigger is a replicated status, so every controller executes the
+        same per-shard growth at the same step boundary —
+        :meth:`_grow_carry_lockstep`.)"""
         if jax.process_count() > 1:
             raise NotImplementedError(
                 f"{what} is single-controller only: the sharded carry is "
@@ -562,25 +565,47 @@ class ShardedTpuChecker(WavefrontChecker):
         carry, more, caps = self._final_state
         return self._carry_to_snapshot(carry, more, *caps)
 
+    # Per-shard growth transforms — THE single definition of the growth
+    # semantics (rehash target, pad fill values, dtypes), shared by the
+    # numpy resume path (_grow_carry) and the lockstep mid-run path
+    # (_grow_carry_lockstep) so the two can never drift.  Each takes one
+    # device's block and returns its grown block.
+
     @staticmethod
-    def _grow_carry(carry_np: list, ndev: int, cap: int, fcap: int, bf: int,
-                    cf: int, status: int):
+    def _rehash_table_block(fp_blk, pl_blk, cap2):
+        from ..ops.buckets import host_bucket_rehash
+
+        return host_bucket_rehash(fp_blk, pl_blk, cap2 // SLOTS)
+
+    @staticmethod
+    def _pad_frontier_block(k: int, blk, grow: int):
+        """Pad carry component ``k`` (2=rows, 3=fps, 4=ebits) at its tail
+        (novel rows are front-compacted)."""
+        if k == 2:
+            return np.concatenate(
+                [blk, np.zeros((grow, blk.shape[-1]), np.uint64)]
+            )
+        if k == 3:
+            return np.concatenate([blk, np.full((grow,), EMPTY, np.uint64)])
+        return np.concatenate([blk, np.zeros((grow,), np.uint32)])
+
+    @classmethod
+    def _grow_carry(cls, carry_np: list, ndev: int, cap: int, fcap: int,
+                    bf: int, cf: int, status: int):
         """Work-preserving growth: transform a consistent (pre-overflow)
         carry for doubled capacity, host-side.  Table shards rehash
         independently (ownership is ``(fp >> 32) % D`` — capacity changes
         only the bucket index *within* a shard); frontier segments pad at
-        their tail (novel rows are front-compacted); the route-bucket and
-        candidate budgets are engine parameters (step-internal buffers), so
-        growing them needs no carry change at all.  Returns
-        ``(cap, fcap, bf, cf, carry_np)`` with status reset to OK."""
-        from ..ops.buckets import host_bucket_rehash
-
+        their tail; the route-bucket and candidate budgets are engine
+        parameters (step-internal buffers), so growing them needs no carry
+        change at all.  Returns ``(cap, fcap, bf, cf, carry_np)`` with
+        status reset to OK."""
         if status == _TABLE_OVERFLOW:
             cap2 = cap * 2
             tfp = np.asarray(carry_np[0]).reshape(ndev, cap)
             tpl = np.asarray(carry_np[1]).reshape(ndev, cap)
             parts = [
-                host_bucket_rehash(tfp[d], tpl[d], cap2 // SLOTS)
+                cls._rehash_table_block(tfp[d], tpl[d], cap2)
                 for d in range(ndev)
             ]
             carry_np[0] = np.concatenate([p[0] for p in parts])
@@ -588,20 +613,16 @@ class ShardedTpuChecker(WavefrontChecker):
             cap = cap2
         elif status == _FRONTIER_OVERFLOW:
             fcap2 = fcap * 2
-            width = np.asarray(carry_np[2]).shape[-1]
-            rows = np.asarray(carry_np[2]).reshape(ndev, fcap, width)
-            fps = np.asarray(carry_np[3]).reshape(ndev, fcap)
-            ebt = np.asarray(carry_np[4]).reshape(ndev, fcap)
             grow = fcap2 - fcap
-            carry_np[2] = np.concatenate(
-                [rows, np.zeros((ndev, grow, width), np.uint64)], axis=1
-            ).reshape(ndev * fcap2, width)
-            carry_np[3] = np.concatenate(
-                [fps, np.full((ndev, grow), EMPTY, np.uint64)], axis=1
-            ).reshape(-1)
-            carry_np[4] = np.concatenate(
-                [ebt, np.zeros((ndev, grow), np.uint32)], axis=1
-            ).reshape(-1)
+            for k in (2, 3, 4):
+                blk = np.asarray(carry_np[k])
+                blocks = [
+                    cls._pad_frontier_block(
+                        k, blk[d * fcap : (d + 1) * fcap], grow
+                    )
+                    for d in range(ndev)
+                ]
+                carry_np[k] = np.concatenate(blocks)
             fcap = fcap2
         elif status == _BUCKET_OVERFLOW:
             bf *= 2
@@ -609,6 +630,77 @@ class ShardedTpuChecker(WavefrontChecker):
             cf *= 2
         carry_np[9] = np.int32(_OK)
         return cap, fcap, bf, cf, carry_np
+
+    def _grow_carry_lockstep(self, carry, cap, fcap, bf, cf, status):
+        """Mid-run growth that works under multi-controller SPMD: the
+        trigger (``status``) is a replicated psum'd scalar, so EVERY
+        controller enters here at the same step boundary with identical
+        parameters.  Each controller transforms only its ADDRESSABLE
+        shards host-side (growth is per-shard local: table shards rehash
+        independently — ownership is ``(fp >> 32) % D``, capacity only
+        changes the bucket index within a shard — and frontier segments
+        pad at their tail), then reassembles global arrays with
+        ``make_array_from_single_device_arrays``.  No cross-host data
+        moves; the controllers stay in lockstep because the transform is
+        deterministic.  Returns ``(cap, fcap, bf, cf, new_carry)`` with
+        the replicated status reset to OK."""
+        from jax.sharding import NamedSharding
+
+        shard_sp = NamedSharding(self.mesh, P(AXIS))
+        repl_sp = NamedSharding(self.mesh, P())
+        ndev = self.ndev
+
+        def reassemble(bufs_by_dev, global_rows, trailing):
+            bufs = [
+                jax.device_put(blk, dev) for dev, blk in bufs_by_dev
+            ]
+            return jax.make_array_from_single_device_arrays(
+                (global_rows,) + trailing, shard_sp, bufs
+            )
+
+        new = list(carry[:10])
+        if status == _TABLE_OVERFLOW:
+            cap2 = cap * 2
+            pl_by_dev = {
+                sh.device: np.asarray(sh.data)
+                for sh in carry[1].addressable_shards
+            }
+            fp_bufs, pl_bufs = [], []
+            for sh in carry[0].addressable_shards:
+                nfp, npl = self._rehash_table_block(
+                    np.asarray(sh.data), pl_by_dev[sh.device], cap2
+                )
+                fp_bufs.append((sh.device, nfp))
+                pl_bufs.append((sh.device, npl))
+            new[0] = reassemble(fp_bufs, ndev * cap2, ())
+            new[1] = reassemble(pl_bufs, ndev * cap2, ())
+            cap = cap2
+        elif status == _FRONTIER_OVERFLOW:
+            fcap2 = fcap * 2
+            grow = fcap2 - fcap
+            for k in (2, 3, 4):
+                bufs = [
+                    (
+                        sh.device,
+                        self._pad_frontier_block(
+                            k, np.asarray(sh.data), grow
+                        ),
+                    )
+                    for sh in carry[k].addressable_shards
+                ]
+                new[k] = reassemble(
+                    bufs, ndev * fcap2, carry[k].shape[1:]
+                )
+            fcap = fcap2
+        elif status == _BUCKET_OVERFLOW:
+            bf *= 2  # engine parameter only: the carry is unchanged
+        elif status == _CAND_OVERFLOW:
+            cf *= 2
+        ok = np.int32(_OK)
+        new[9] = jax.make_array_from_callback(
+            (), repl_sp, lambda idx: ok
+        )
+        return cap, fcap, bf, cf, tuple(new)
 
     def _run(self):
         if self._resume is not None:
@@ -715,16 +807,15 @@ class ShardedTpuChecker(WavefrontChecker):
                         bf *= 2
                 else:
                     # mid-run overflow: the atomic step rolled back, so the
-                    # carry is consistent — grow host-side and resume.
-                    # Lockstep-safe to raise here multi-controller: status is
-                    # replicated, so EVERY controller takes this branch.
-                    self._require_single_controller("mid-run growth")
+                    # carry is consistent — grow and resume.  Works under
+                    # multi-controller SPMD: status is replicated, so EVERY
+                    # controller takes this branch at the same step boundary
+                    # and performs the identical per-shard transform on its
+                    # own addressable data (lockstep growth).
                     self.growth_events.append((status, unique))
-                    carry_np = [np.asarray(c) for c in jax.device_get(carry)]
-                    cap, fcap, bf, cf, carry_np = self._grow_carry(
-                        carry_np, self.ndev, cap, fcap, bf, cf, status
+                    cap, fcap, bf, cf, pending = self._grow_carry_lockstep(
+                        carry, cap, fcap, bf, cf, status
                     )
-                    pending = carry_np
                 continue
             break
         self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf

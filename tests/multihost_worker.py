@@ -40,7 +40,6 @@ def main() -> int:
         mesh=None,
         n_devices=4 * nproc,  # the full global mesh, spanning both processes
         sync=True,
-        # pre-sized: mid-run growth is single-controller only
         capacity=1 << 13,
         frontier_capacity=1 << 9,
     )
@@ -51,6 +50,28 @@ def main() -> int:
     for name, path in discs.items():
         checker.assert_discovery(name, list(path.actions()))
     print(f"multihost-worker-ok p{pid}: unique=288 discoveries={sorted(discs)}")
+
+    # LOCKSTEP GROWTH under multi-controller SPMD: capacities sized to
+    # overflow mid-run, so every controller must execute the same
+    # per-shard growth at the same step boundary and the run must still
+    # land the pinned count with monotone unique counters across events.
+    m2 = TwoPhaseSys(3)
+    grower = m2.checker().spawn_tpu(
+        mesh=None,
+        n_devices=4 * nproc,
+        sync=True,
+        capacity=1 << 7,
+        frontier_capacity=1 << 5,
+    )
+    assert grower.unique_state_count() == 288, grower.unique_state_count()
+    assert len(grower.growth_events) >= 1, grower.growth_events
+    uniq = [u for _, u in grower.growth_events]
+    assert uniq == sorted(uniq) and all(u >= 0 for u in uniq), uniq
+    assert set(grower.discoveries()) == {"abort agreement", "commit agreement"}
+    print(
+        f"multihost-growth-ok p{pid}: unique=288 "
+        f"growth_events={len(grower.growth_events)} monotone={uniq}"
+    )
     return 0
 
 

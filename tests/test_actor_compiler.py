@@ -68,6 +68,39 @@ def test_history_codec_roundtrip_and_verdicts():
     assert seen == len(hc.table_keys) == 124
 
 
+def test_multiop_codec_roundtrip_and_verdicts():
+    """put_count=2 codec (reference ``register.rs:96,178-186``): every
+    enumerated joint tester state round-trips fields→tester→fields and the
+    baked verdict equals the live tester's — including write-invocation
+    snapshots, which the K=1 layout cannot express."""
+    import numpy as np
+
+    from stateright_tpu.parallel.history_tensor import MultiOpLinHistoryCodec
+
+    hc = MultiOpLinHistoryCodec([2, 3], [["A", "Z"], ["B", "Y"]], "\0")
+    assert hc.K == 2 and len(hc.table_keys) == 2016
+    step = max(1, len(hc.table_keys) // 200)
+    for idx in range(0, len(hc.table_keys), step):
+        key = int(hc.table_keys[idx])
+        fields = []
+        for i in range(hc.C):
+            word = (key >> (i * hc.thread_bits)) & (
+                (1 << hc.thread_bits) - 1
+            )
+            phase = word & ((1 << hc.phase_bits) - 1)
+            off = hc.phase_bits
+            snaps = []
+            for _ in range(hc.K):
+                snaps.append((word >> off) & ((1 << hc.snap_bits) - 1))
+                off += hc.snap_bits
+            rval = (word >> off) & ((1 << hc.rval_bits) - 1)
+            fields.append((phase, tuple(snaps), rval))
+        tester = hc.tester_of_fields(fields)
+        assert hc.fields_of_tester(tester) == fields
+        assert hc.key_of_fields(fields) == key
+        assert bool(hc.table_ok[idx]) == tester.is_consistent()
+
+
 # ---------------------------------------------------------------------------
 # single-copy register (compiled)
 # ---------------------------------------------------------------------------
@@ -129,6 +162,80 @@ def test_abd_tpu_pinned_counts():
     assert t.unique_state_count() == 544
     assert set(t.discoveries()) == {"value chosen"}
     t.assert_properties()
+
+
+def test_abd_put2_host_device_pinned():
+    """put_count=2 ABD (the round-4 device-story gap: reference
+    ``register.rs:96,178-186`` supports arbitrary put_count, the compiler
+    stopped at 1): full enumeration pinned host=device with discovery
+    parity.  ABD stays linearizable, so no 'linearizable' discovery."""
+    m = abd_model(2, 2, put_count=2)
+    h = m.checker().spawn_bfs().join()
+    assert h.unique_state_count() == 2980
+    t = m.checker().spawn_tpu(sync=True, capacity=1 << 14)
+    assert t.unique_state_count() == 2980
+    assert sorted(t.discoveries()) == sorted(h.discoveries()) == [
+        "value chosen"
+    ]
+    t.assert_properties()
+
+
+def test_singlecopy_put2_violation_discovery_parity():
+    """The put_count=2 linearizability verdict's FALSE path: two
+    unreplicated servers violate; host and device both discover it, and
+    the device witness re-executes to a genuinely inconsistent history."""
+    m = single_copy_model(2, 2, put_count=2)
+    h = m.checker().spawn_bfs().join()
+    t = m.checker().spawn_tpu(sync=True, capacity=1 << 12)
+    assert sorted(t.discoveries()) == sorted(h.discoveries()) == [
+        "linearizable",
+        "value chosen",
+    ]
+    final = t.discoveries()["linearizable"].final_state()
+    assert not final.history.is_consistent()
+    h.assert_discovery(
+        "linearizable", list(t.discoveries()["linearizable"].actions())
+    )
+
+
+def test_singlecopy_put2_full_crawl_equivalence():
+    """Per-state equivalence over the FULL put_count=2 single-copy space
+    (no early exit): encode/decode round-trip, fingerprint agreement,
+    successor-set equality, and property-mask agreement — including
+    states where the device linearizability verdict is False."""
+    m = single_copy_model(2, 2, put_count=2)
+    tm = m.tensor_model()
+    assert isinstance(tm, CompiledActorTensor)
+    seen = crawl_and_check(m, tm)
+    assert len(seen) == 384
+
+
+def test_singlecopy_put2_single_server_pinned():
+    m = single_copy_model(2, 1, put_count=2)
+    h = m.checker().spawn_bfs().join()
+    assert h.unique_state_count() == 369
+    t = m.checker().spawn_tpu(sync=True, capacity=1 << 12)
+    assert t.unique_state_count() == 369
+    assert set(t.discoveries()) == {"value chosen"}
+    t.assert_properties()
+
+
+def test_wo_rejects_put2():
+    """Write-once workloads stay put_count=1 (a failed write changes
+    which op takes effect; the multi-op codec models write_ok only)."""
+    from stateright_tpu.actor.write_once_register import WORegisterClient
+    from stateright_tpu.models.write_once_register import wo_register_model
+    from stateright_tpu.parallel.actor_compiler import (
+        CompileError,
+        compile_actor_model,
+    )
+
+    m = wo_register_model(2, 1)
+    for a in m.actors:
+        if isinstance(a, WORegisterClient):
+            a.put_count = 2
+    with pytest.raises(CompileError, match="put_count"):
+        compile_actor_model(m)
 
 
 def test_abd_sharded_matches():

@@ -56,23 +56,45 @@ def test_sharded_engine_multi_controller_2pc3():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
         assert f"multihost-worker-ok p{pid}" in out, out[-2000:]
+        # lockstep growth: both controllers grew at the same boundaries and
+        # still landed the pinned count with monotone counters
+        assert f"multihost-growth-ok p{pid}" in out, out[-2000:]
+
+
+def test_lockstep_growth_not_fenced_under_multi_controller(monkeypatch):
+    """Mid-run growth no longer raises under multi-controller SPMD (the
+    round-4 fence): with a simulated second controller, a run forced to
+    grow completes via the per-shard lockstep transform."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.parallel import sharded
+
+    m = TwoPhaseSys(4)
+    monkeypatch.setattr(sharded.jax, "process_count", lambda: 2)
+    c = m.checker().spawn_tpu(
+        sync=True, devices=8, capacity=1 << 8, frontier_capacity=1 << 5
+    )
+    assert c.unique_state_count() == 1568  # pinned 2pc@4
+    assert len(c.growth_events) >= 1
+    uniq = [u for _, u in c.growth_events]
+    assert uniq == sorted(uniq)
 
 
 def test_async_run_thread_error_surfaces_at_join(monkeypatch):
-    """A single-controller-only path hit inside an ASYNC run (e.g. mid-run
-    growth under multi-controller SPMD) must raise at join(), not leave a
-    forever-undone checker with counters silently reading 0."""
+    """An error raised inside an ASYNC run thread must raise at join(),
+    not leave a forever-undone checker with counters silently reading 0.
+    (The engine build happens inside the run thread on cache miss, so a
+    build failure is a faithful run-thread error.)"""
     import pytest
 
     from stateright_tpu.models.two_phase_commit import TwoPhaseSys
     from stateright_tpu.parallel import sharded
 
-    m = TwoPhaseSys(4)
-    # simulate a second controller process so the growth guard trips; the
-    # tiny capacity forces a mid-run growth event
-    monkeypatch.setattr(sharded.jax, "process_count", lambda: 2)
-    c = m.checker().spawn_tpu(
-        sync=False, devices=8, capacity=1 << 8, frontier_capacity=1 << 5
+    def boom(*a, **k):
+        raise RuntimeError("boom in run thread")
+
+    monkeypatch.setattr(sharded, "_build_sharded_run", boom)
+    c = TwoPhaseSys(3).checker().spawn_tpu(
+        sync=False, devices=8, capacity=1 << 13, frontier_capacity=1 << 9
     )
-    with pytest.raises(NotImplementedError, match="single-controller"):
+    with pytest.raises(RuntimeError, match="boom in run thread"):
         c.join()
