@@ -107,7 +107,16 @@ class CheckerBuilder:
         the host engine that supports representative dedup, as in the
         reference where symmetry is DFS-only."""
         if self.visitor_obj is not None:
-            return self.spawn_bfs()  # device engines reject visitors
+            # device engines reject visitors (they never materialize
+            # states), so there is no CPU-vs-device decision to probe —
+            # just run the best host engine: process-parallel BFS when
+            # the box has cores to use (it supports visitors via replay,
+            # and symmetry), else the thread pool
+            import os as _os
+
+            if (_os.cpu_count() or 1) > 1:
+                return self.spawn_mp_bfs()
+            return self.spawn_bfs()
         try:
             cached = getattr(self.model, "_tensor_cached", None)
             twin = (

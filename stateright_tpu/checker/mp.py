@@ -42,10 +42,18 @@ arrival batches are folded in worker order, making the reduced counts
 deterministic for a fixed worker count (like the device engines, whose
 counts are pinned per mesh width).
 
-Limitations (documented, asserted): visitors are not supported (they need
-cross-process callbacks with ordering guarantees the oracle tier gets
-from the thread pool instead).  Discovery *paths* are reconstructed by
-the parent from the merged visited map, same as ``bfs.py``.
+**Visitors** work here too (closing the reference's multi-core-or-visitor
+tradeoff): callbacks cannot cross process boundaries, so workers record
+their per-round visit order (fingerprints only) and the PARENT replays
+every visit after the merge — round-major, worker-minor, a deterministic
+valid BFS level order — reconstructing each ``Path`` from the complete
+parent-pointer map.  Recorders and snapshot visitors observe exactly the
+states a thread checker would show them; the one semantic difference is
+WHEN (after the run, not during), which only matters to a visitor that
+races the live run — none of the reference's do.
+
+Discovery *paths* are reconstructed by the parent from the merged visited
+map, same as ``bfs.py``.
 """
 
 from __future__ import annotations
@@ -81,8 +89,6 @@ class MpBfsChecker(ParentPointerTrace, Checker):
     """
 
     def __init__(self, options: CheckerBuilder, processes: Optional[int] = None):
-        if options.visitor_obj is not None:
-            raise ValueError("mp BFS does not support visitors; use spawn_bfs")
         self.model = options.model
         self._props = list(self.model.properties())
         # an EXPLICIT processes count wins verbatim (processes=1 is a valid
@@ -105,13 +111,14 @@ class MpBfsChecker(ParentPointerTrace, Checker):
             if options.timeout_secs is not None
             else None
         )
+        want_visits = options.visitor_obj is not None
         workers = [
             ctx.Process(
                 target=_worker_main,
                 args=(
                     i, n, self.model, self._props, queues, result_q, stats,
                     barrier, options.target_state_count, deadline,
-                    options.symmetry_fn,
+                    options.symmetry_fn, want_visits,
                 ),
                 daemon=True,
             )
@@ -162,7 +169,7 @@ class MpBfsChecker(ParentPointerTrace, Checker):
         # both discovered a property, the surviving witness fingerprint (and
         # therefore the reconstructed trace) must not depend on OS scheduling
         for who in sorted(results):
-            visited, disc, count = results[who]
+            visited, disc, count, _ = results[who]
             for fp, pfp in visited.values():
                 self._generated[fp] = pfp
             for name, fp in disc.items():
@@ -170,6 +177,28 @@ class MpBfsChecker(ParentPointerTrace, Checker):
             self._count += count
         for w in workers:
             w.join()
+        if want_visits:
+            self._replay_visits(options.visitor_obj, results)
+
+    def _replay_visits(self, visitor, results: dict) -> None:
+        """Replay every worker's recorded visit order through the parent's
+        visitor — round-major, worker-minor (a deterministic, valid BFS
+        level order) — with paths reconstructed from the now-complete
+        merged parent map (callbacks cannot cross the process boundary)."""
+        from .path import Path
+
+        logs = {who: results[who][3] for who in results}
+        rounds = max((len(l) for l in logs.values()), default=0)
+        for r in range(rounds):
+            for who in sorted(logs):
+                log = logs[who]
+                if r >= len(log):
+                    continue
+                for fp in log[r]:
+                    visitor.visit(
+                        self.model,
+                        Path.from_fingerprints(self.model, self._trace(fp)),
+                    )
 
     # -- Checker surface -----------------------------------------------------
 
@@ -190,12 +219,12 @@ class MpBfsChecker(ParentPointerTrace, Checker):
 
 def _worker_main(
     me, n, model, props, queues, result_q, stats, barrier, target, deadline,
-    symmetry=None,
+    symmetry=None, want_visits=False,
 ):
     try:
         _worker_loop(
             me, n, model, props, queues, result_q, stats, barrier, target,
-            deadline, symmetry,
+            deadline, symmetry, want_visits,
         )
     except Exception:  # noqa: BLE001 - reported to the parent, peers unblocked
         tb = traceback.format_exc()
@@ -208,7 +237,7 @@ def _worker_main(
 
 def _worker_loop(
     me, n, model, props, queues, result_q, stats, barrier, target, deadline,
-    symmetry=None,
+    symmetry=None, want_visits=False,
 ):
     prop_count = len(props)
     full_mask = (1 << prop_count) - 1
@@ -248,8 +277,14 @@ def _worker_loop(
             visited[key] = (fp, 0)
             frontier.append((s, fp, ebits0))
 
+    # per-round visit order (fps only — the parent replays them through
+    # the visitor after the merge; see MpBfsChecker._replay_visits)
+    visit_log: list[list[int]] = []
+
     rnd = 0
     while True:
+        if want_visits:
+            visit_log.append([fp for _, fp, _ in frontier])
         buckets: list[list] = [[] for _ in range(n)]
         for state, fp, ebits in frontier:
             ebits = evaluate_properties(
@@ -329,7 +364,9 @@ def _worker_loop(
             break
         rnd += 1
 
-    result_q.put(("done", me, (visited, discoveries, local_count)))
+    result_q.put(
+        ("done", me, (visited, discoveries, local_count, visit_log))
+    )
 
 
 def spawn_mp_bfs(model, workers: Optional[int] = None, target_states=None):

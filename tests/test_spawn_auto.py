@@ -64,7 +64,10 @@ def test_no_tensor_twin_checks_on_cpu():
 
 def test_visitor_forces_cpu():
     """Visitors need host state materialization, which the device engines
-    reject — auto selection respects that outright."""
+    reject — auto selection runs the best host engine outright (mp-BFS
+    on multi-core boxes, the thread pool on single-core ones)."""
+    from stateright_tpu.checker.mp import MpBfsChecker
+
     seen = []
     c = (
         TwoPhaseSys(3)
@@ -72,7 +75,7 @@ def test_visitor_forces_cpu():
         .visitor(lambda model, path: seen.append(path.final_state()))
         .spawn_auto()
     )
-    assert isinstance(c, BfsChecker)
+    assert isinstance(c, (BfsChecker, MpBfsChecker))
     c.join()
     assert len(seen) == 288
 
