@@ -96,27 +96,83 @@ class CheckerBuilder:
         544-state space ran 927 states/s on a v5e vs 7.4k/s on one CPU
         core).
 
-        Strategy: (1) models with no tensor twin, a compile error, or a
-        visitor check on CPU outright; (2) otherwise a CPU probe runs
-        first, bounded by ``probe_secs`` — if the space exhausts within
-        the budget, the finished CPU checker IS the result and the device
-        is never touched; (3) a space that outlives the probe is big
-        enough that the device engine wins, so the check restarts there
-        (``tpu_kw`` passes through to :meth:`spawn_tpu`), having spent
-        only the probe budget.  With ``symmetry()`` the probe uses DFS —
-        the host engine that supports representative dedup, as in the
-        reference where symmetry is DFS-only."""
+        Strategy: (1) a thread-engine probe runs first, bounded by
+        ``probe_secs`` — if the space exhausts within the budget, the
+        finished checker IS the result and nothing bigger is ever paid
+        for; (2) a space that outlives the probe escalates to the
+        heavier engine, having spent only the probe budget (and with the
+        probe's wall-clock deducted from any user ``timeout()``).  The
+        heavier engine is the device wavefront (``tpu_kw`` passes
+        through to :meth:`spawn_tpu`) — except with a visitor, which
+        device engines reject, where it is the process-parallel mp-BFS
+        (multi-core + visitor via replay), available only where ``fork``
+        exists.  Models with no tensor twin or a compile error check on
+        the thread engines outright.  With ``symmetry()`` the probe uses
+        DFS — the host thread engine that supports representative dedup,
+        as in the reference where symmetry is DFS-only."""
+        import time as _time
+
+        cpu_spawn = self.spawn_dfs if self.symmetry_fn else self.spawn_bfs
+
+        def probe_then(escalate, small=None):
+            """Visitor-free sizing probe on the thread engine, then either
+            the ``small`` outcome (default: the finished probe itself) or
+            ``escalate``.
+
+            Timeout semantics: without a visitor, the probe's wall-clock
+            is deducted from the user ``timeout()`` so total time stays
+            within budget.  WITH a visitor the final engine gets the FULL
+            user timeout instead (total may overshoot by at most
+            ``probe_secs``): callbacks must fire exactly once on a
+            fully-budgeted run — deducting would let an internal probe
+            starve the visible run into a partial result, or swallow the
+            callbacks entirely."""
+            if (
+                self.timeout_secs is not None
+                and self.timeout_secs <= probe_secs
+            ):
+                return cpu_spawn()  # the whole run fits in the probe budget
+            saved = self.timeout_secs
+            vis, self.visitor_obj = self.visitor_obj, None
+            self.timeout_secs = probe_secs
+            t0 = _time.monotonic()
+            try:
+                probe = cpu_spawn().join()
+            finally:
+                self.timeout_secs = saved
+                self.visitor_obj = vis
+            if not probe.timed_out:
+                return probe if small is None else small()
+            if saved is None or vis is not None:
+                return escalate()
+            remaining = saved - (_time.monotonic() - t0)
+            if remaining <= 0:
+                return probe  # budget gone: the partial probe result is it
+            self.timeout_secs = remaining
+            try:
+                return escalate()
+            finally:
+                self.timeout_secs = saved
+
         if self.visitor_obj is not None:
             # device engines reject visitors (they never materialize
-            # states), so there is no CPU-vs-device decision to probe —
-            # just run the best host engine: process-parallel BFS when
-            # the box has cores to use (it supports visitors via replay,
-            # and symmetry), else the thread pool
+            # states), so big spaces escalate to the process-parallel
+            # BFS instead (visitors via replay, symmetry supported) —
+            # when there are cores to win and fork exists (the model
+            # travels to workers by address-space inheritance).  The
+            # probe runs visitor-FREE (callbacks must fire exactly once,
+            # on the final engine only); a small space then re-runs the
+            # thread engine with the visitor attached, which the probe
+            # just proved cheap.
+            import multiprocessing as _mp
             import os as _os
 
-            if (_os.cpu_count() or 1) > 1:
-                return self.spawn_mp_bfs()
-            return self.spawn_bfs()
+            can_mp = (_os.cpu_count() or 1) > 1 and (
+                "fork" in _mp.get_all_start_methods()
+            )
+            if not can_mp:
+                return cpu_spawn()
+            return probe_then(self.spawn_mp_bfs, small=cpu_spawn)
         try:
             cached = getattr(self.model, "_tensor_cached", None)
             twin = (
@@ -126,34 +182,9 @@ class CheckerBuilder:
             )
         except Exception:  # noqa: BLE001 - CompileError etc: host fallback
             twin = None
-        cpu_spawn = self.spawn_dfs if self.symmetry_fn else self.spawn_bfs
         if twin is None:
             return cpu_spawn()
-        if self.timeout_secs is not None and self.timeout_secs <= probe_secs:
-            return cpu_spawn()  # the whole run fits in the probe budget
-        import time as _time
-
-        saved = self.timeout_secs
-        self.timeout_secs = probe_secs
-        t0 = _time.monotonic()
-        try:
-            probe = cpu_spawn().join()
-        finally:
-            self.timeout_secs = saved
-        if not probe.timed_out:
-            return probe
-        # escalation honors the ORIGINAL timeout budget: the probe's spent
-        # wall-clock is deducted so total time stays within .timeout()
-        if saved is not None:
-            remaining = saved - (_time.monotonic() - t0)
-            if remaining <= 0:
-                return probe  # budget gone: the partial CPU result is it
-            self.timeout_secs = remaining
-            try:
-                return self.spawn_tpu(**tpu_kw)
-            finally:
-                self.timeout_secs = saved
-        return self.spawn_tpu(**tpu_kw)
+        return probe_then(lambda: self.spawn_tpu(**tpu_kw))
 
     def spawn_tpu(self, **kw) -> "Checker":
         """The point of this framework: wavefront BFS on TPU (no reference

@@ -1,6 +1,9 @@
 """Shared CLI plumbing for example models (reference per-example ``main()``,
 e.g. ``examples/paxos.rs:314-395``): subcommands ``check [args]``,
-``check-sym``, ``explore [addr]``, ``spawn``, with positional arguments."""
+``check-sym``, ``explore [addr]``, ``spawn``, with positional arguments.
+Beyond the reference's verbs: ``check-tpu`` / ``check-sym-tpu`` (device
+engines) and ``check-auto`` (measured engine selection,
+``CheckerBuilder.spawn_auto``)."""
 
 from __future__ import annotations
 
@@ -15,6 +18,7 @@ def run_cli(
     check_sym: Optional[Callable[[list], None]] = None,
     check_tpu: Optional[Callable[[list], None]] = None,
     check_sym_tpu: Optional[Callable[[list], None]] = None,
+    check_auto: Optional[Callable[[list], None]] = None,
     explore: Optional[Callable[[list], None]] = None,
     spawn: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
@@ -30,6 +34,8 @@ def run_cli(
         check_tpu(rest)
     elif cmd == "check-sym-tpu" and check_sym_tpu is not None:
         check_sym_tpu(rest)
+    elif cmd == "check-auto" and check_auto is not None:
+        check_auto(rest)
     elif cmd == "explore" and explore is not None:
         explore(rest)
     elif cmd == "spawn" and spawn is not None:

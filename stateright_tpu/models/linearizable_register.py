@@ -292,6 +292,14 @@ def main(argv=None):
             return
         m.checker().spawn_tpu().report()
 
+    def check_auto(rest):
+        client_count = int(rest[0]) if rest else 2
+        print(
+            f"Model checking a linearizable register with {client_count} "
+            "clients (auto engine selection)."
+        )
+        abd_model(client_count, 2).checker().spawn_auto().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -315,10 +323,12 @@ def main(argv=None):
     run_cli(
         "  linearizable_register check [CLIENT_COUNT] [NETWORK]\n"
         "  linearizable_register check-tpu [CLIENT_COUNT] [NETWORK]\n"
+        "  linearizable_register check-auto [CLIENT_COUNT]\n"
         "  linearizable_register explore [CLIENT_COUNT] [ADDRESS]\n"
         "  linearizable_register spawn",
         check,
         check_tpu=check_tpu,
+        check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

@@ -306,6 +306,14 @@ def main(argv=None):
             b = b.target_states(target)
         b.spawn_tpu().report()
 
+    def check_auto(rest):
+        client_count = int(rest[0]) if rest else 2
+        print(
+            f"Model checking Single Decree Paxos with {client_count} "
+            "clients (auto engine selection)."
+        )
+        paxos_model(client_count, 3).checker().spawn_auto().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -334,10 +342,12 @@ def main(argv=None):
     run_cli(
         "  paxos check [CLIENT_COUNT] [NETWORK]\n"
         "  paxos check-tpu [CLIENT_COUNT] [TARGET_STATES]\n"
+        "  paxos check-auto [CLIENT_COUNT]\n"
         "  paxos explore [CLIENT_COUNT] [ADDRESS]\n"
         "  paxos spawn",
         check,
         check_tpu=check_tpu,
+        check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

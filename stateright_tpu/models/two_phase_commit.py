@@ -392,6 +392,14 @@ def main(argv=None):
         )
         TwoPhaseSys(rm_count).checker().symmetry().spawn_tpu().report()
 
+    def check_auto(rest):
+        rm_count = int(rest[0]) if rest else 2
+        print(
+            f"Checking two phase commit with {rm_count} RMs "
+            "(auto engine selection)."
+        )
+        TwoPhaseSys(rm_count).checker().spawn_auto().report()
+
     def explore(rest):
         rm_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -403,11 +411,13 @@ def main(argv=None):
         "  two_phase_commit check-sym [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-tpu [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-sym-tpu [RESOURCE_MANAGER_COUNT]\n"
+        "  two_phase_commit check-auto [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit explore [RESOURCE_MANAGER_COUNT] [ADDRESS]",
         check,
         check_sym=check_sym,
         check_tpu=check_tpu,
         check_sym_tpu=check_sym_tpu,
+        check_auto=check_auto,
         explore=explore,
         argv=argv,
     )

@@ -62,12 +62,10 @@ def test_no_tensor_twin_checks_on_cpu():
     assert set(c.discoveries()) == {"one"}
 
 
-def test_visitor_forces_cpu():
-    """Visitors need host state materialization, which the device engines
-    reject — auto selection runs the best host engine outright (mp-BFS
-    on multi-core boxes, the thread pool on single-core ones)."""
-    from stateright_tpu.checker.mp import MpBfsChecker
-
+def test_visitor_small_space_finishes_on_thread_probe():
+    """Visitors: the device engines are out, but the probe still runs —
+    a small space is answered by the finished thread checker without
+    paying mp fork/queue setup."""
     seen = []
     c = (
         TwoPhaseSys(3)
@@ -75,9 +73,30 @@ def test_visitor_forces_cpu():
         .visitor(lambda model, path: seen.append(path.final_state()))
         .spawn_auto()
     )
-    assert isinstance(c, (BfsChecker, MpBfsChecker))
+    assert isinstance(c, BfsChecker)
     c.join()
     assert len(seen) == 288
+
+
+def test_visitor_large_space_escalates_to_mp(monkeypatch):
+    """A visitor run whose space outgrows the probe escalates to the
+    process-parallel BFS (multi-core + visitor via replay), never to a
+    device engine."""
+    import os
+
+    from stateright_tpu.checker.mp import MpBfsChecker
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    seen = []
+    c = (
+        TwoPhaseSys(5)
+        .checker()
+        .visitor(lambda model, path: seen.append(1))
+        .spawn_auto(probe_secs=0.01)
+    )
+    assert isinstance(c, MpBfsChecker)
+    assert c.unique_state_count() == 8832
+    assert len(seen) == 8832
 
 
 def test_symmetry_probe_uses_dfs():
