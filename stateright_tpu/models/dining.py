@@ -159,6 +159,13 @@ def main(argv=None) -> None:
             return
         m.checker().spawn_tpu().report()
 
+    def check_auto(rest):
+        n = parse(rest)
+        print(f"Model checking {n} dining philosophers (auto engine).")
+        dining_model(n).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
+
     def explore(rest):
         n = parse(rest)
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -168,6 +175,7 @@ def main(argv=None) -> None:
         "dining [PHILOSOPHER_COUNT]",
         check,
         check_tpu=check_tpu,
+        check_auto=check_auto,
         explore=explore,
         argv=argv,
     )

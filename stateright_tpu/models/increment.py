@@ -72,6 +72,13 @@ def main(argv=None):
             default_threads()
         ).symmetry().spawn_dfs().report()
 
+    def check_auto(rest):
+        n = int(rest[0]) if rest else 3
+        print(f"Model checking increment with {n} threads (auto engine).")
+        Increment(n).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
+
     def explore(rest):
         n = int(rest[0]) if rest else 3
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -80,9 +87,11 @@ def main(argv=None):
     run_cli(
         "  increment check [THREAD_COUNT]\n"
         "  increment check-sym [THREAD_COUNT]\n"
+        "  increment check-auto [THREAD_COUNT]\n"
         "  increment explore [THREAD_COUNT] [ADDRESS]",
         check,
         check_sym=check_sym,
+        check_auto=check_auto,
         explore=explore,
         argv=argv,
     )

@@ -84,6 +84,13 @@ def main(argv=None):
             default_threads()
         ).symmetry().spawn_dfs().report()
 
+    def check_auto(rest):
+        n = int(rest[0]) if rest else 3
+        print(f"Model checking increment-lock with {n} threads (auto engine).")
+        IncrementLock(n).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
+
     def explore(rest):
         n = int(rest[0]) if rest else 3
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -92,9 +99,11 @@ def main(argv=None):
     run_cli(
         "  increment_lock check [THREAD_COUNT]\n"
         "  increment_lock check-sym [THREAD_COUNT]\n"
+        "  increment_lock check-auto [THREAD_COUNT]\n"
         "  increment_lock explore [THREAD_COUNT] [ADDRESS]",
         check,
         check_sym=check_sym,
+        check_auto=check_auto,
         explore=explore,
         argv=argv,
     )

@@ -298,7 +298,9 @@ def main(argv=None):
             f"Model checking a linearizable register with {client_count} "
             "clients (auto engine selection)."
         )
-        abd_model(client_count, 2).checker().spawn_auto().report()
+        abd_model(client_count, 2).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
 
     def explore(rest):
         client_count = int(rest[0]) if rest else 2

@@ -312,7 +312,9 @@ def main(argv=None):
             f"Model checking Single Decree Paxos with {client_count} "
             "clients (auto engine selection)."
         )
-        paxos_model(client_count, 3).checker().spawn_auto().report()
+        paxos_model(client_count, 3).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
 
     def explore(rest):
         client_count = int(rest[0]) if rest else 2

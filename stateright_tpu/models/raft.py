@@ -248,6 +248,16 @@ def main(argv=None) -> None:
             return
         m.checker().spawn_tpu().report()
 
+    def check_auto(rest):
+        n, network = parse(rest)
+        print(
+            f"Model checking Raft leader election with {n} servers "
+            "(auto engine selection)."
+        )
+        raft_model(n, network=network).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
+
     def explore(rest):
         n = int(rest[0]) if rest else 3
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -283,6 +293,7 @@ def main(argv=None) -> None:
         check_sym=check_sym,
         check_tpu=check_tpu,
         check_sym_tpu=check_sym_tpu,
+        check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

@@ -117,6 +117,21 @@ def main(argv=None):
             return
         m.checker().spawn_tpu().report()
 
+    def check_auto(rest):
+        client_count = int(rest[0]) if rest else 2
+        network = (
+            Network.from_name(rest[1])
+            if len(rest) > 1
+            else Network.new_unordered_nonduplicating()
+        )
+        print(
+            f"Model checking a single-copy register with {client_count} "
+            "clients (auto engine selection)."
+        )
+        single_copy_model(client_count, 1, network).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -132,10 +147,12 @@ def main(argv=None):
     run_cli(
         "  single_copy_register check [CLIENT_COUNT] [NETWORK]\n"
         "  single_copy_register check-tpu [CLIENT_COUNT] [NETWORK]\n"
+        "  single_copy_register check-auto [CLIENT_COUNT] [NETWORK]\n"
         "  single_copy_register explore [CLIENT_COUNT] [ADDRESS]\n"
         "  single_copy_register spawn",
         check,
         check_tpu=check_tpu,
+        check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

@@ -398,7 +398,9 @@ def main(argv=None):
             f"Checking two phase commit with {rm_count} RMs "
             "(auto engine selection)."
         )
-        TwoPhaseSys(rm_count).checker().spawn_auto().report()
+        TwoPhaseSys(rm_count).checker().threads(
+            default_threads()
+        ).spawn_auto().report()
 
     def explore(rest):
         rm_count = int(rest[0]) if rest else 2

@@ -147,6 +147,16 @@ def main(argv=None):
             lambda s: server_representative(s, server_count)
         ).spawn_dfs().report()
 
+    def check_auto(rest):
+        client_count, server_count, network = parse(rest)
+        print(
+            f"Model checking a write-once register with {client_count} "
+            f"clients and {server_count} servers (auto engine selection)."
+        )
+        wo_register_model(
+            client_count, server_count, network
+        ).checker().threads(default_threads()).spawn_auto().report()
+
     def explore(rest):
         client_count = int(rest[0]) if rest else 2
         addr = rest[1] if len(rest) > 1 else "localhost:3000"
@@ -162,10 +172,12 @@ def main(argv=None):
     run_cli(
         "  write_once_register check [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
         "  write_once_register check-sym [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
+        "  write_once_register check-auto [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
         "  write_once_register explore [CLIENT_COUNT] [ADDRESS]\n"
         "  write_once_register spawn",
         check,
         check_sym=check_sym,
+        check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
         argv=argv,

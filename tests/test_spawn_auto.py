@@ -117,6 +117,30 @@ def test_tiny_user_timeout_stays_on_cpu():
     assert c.unique_state_count() == 288
 
 
+def test_check_auto_cli_verb(capsys):
+    """The ``check-auto`` CLI verb runs end-to-end on every model that
+    wires it, including argument passing (the single-copy NETWORK
+    argument regression class)."""
+    from stateright_tpu.models import (
+        single_copy_register,
+        two_phase_commit,
+        write_once_register,
+    )
+
+    two_phase_commit.main(["check-auto", "3"])
+    out = capsys.readouterr().out
+    assert "auto engine selection" in out
+    assert "unique=288" in out
+
+    single_copy_register.main(["check-auto", "2", "ordered"])
+    out = capsys.readouterr().out
+    assert "Done." in out  # the ordered network parsed and ran
+
+    write_once_register.main(["check-auto", "2", "1"])
+    out = capsys.readouterr().out
+    assert "unique=71" in out
+
+
 def test_timed_out_flag_distinguishes_deadline_from_completion():
     """``timed_out`` is the probe's decision signal: set only by the
     deadline, not by finishing or reaching target_states."""
