@@ -542,6 +542,12 @@ class CompiledActorTensor(TensorModel):
 
         # -- freeze tables ---------------------------------------------------
         ne = len(self._envs)
+        # A system may send no messages at all (empty envelope universe):
+        # allocate a sentinel env column so device gathers stay in range —
+        # no slot is ever occupied, so the sentinel values are always
+        # masked out.  Stored on self so step_rows' flat-index stride and
+        # the table shapes stay in lockstep by construction.
+        nep = self._ne_padded = max(ne, 1)
         self.K = max(
             (len(snds) for (_, snds, _, _) in trans.values()), default=0
         )
@@ -554,10 +560,10 @@ class CompiledActorTensor(TensorModel):
         self._teff_np = []
         for i in range(n):
             ns = len(self._states[i])
-            ti = np.full((ns, ne), -1, np.int32)
-            pi = np.zeros((ns, ne), bool)
-            ki = np.full((ns, ne, max(self.K, 1)), -1, np.int32)
-            ei = np.full((ns, ne), -1, np.int32)
+            ti = np.full((ns, nep), -1, np.int32)
+            pi = np.zeros((ns, nep), bool)
+            ki = np.full((ns, nep, max(self.K, 1)), -1, np.int32)
+            ei = np.full((ns, nep), -1, np.int32)
             for (ai, sc, ec), (nc, snds, poison, teff) in trans.items():
                 if ai != i:
                     continue
@@ -594,19 +600,22 @@ class CompiledActorTensor(TensorModel):
             self._tpoison_np.append(pi)
             self._tbit_np.append(bi)
 
-        # per-envelope metadata
+        # per-envelope metadata (padded to the sentinel width like the
+        # transition tables above)
+        pad = [0] * (nep - ne)
         self._env_dst = np.asarray(
-            [int(e.dst) for e in self._envs], np.int32
+            [int(e.dst) for e in self._envs] + pad, np.int32
         )
         # directed flow id (ordered networks): the envelope code determines
         # (src, dst), so same-code implies same flow
         self._env_pair = np.asarray(
-            [int(e.src) * self.n_actors + int(e.dst) for e in self._envs],
+            [int(e.src) * self.n_actors + int(e.dst) for e in self._envs]
+            + pad,
             np.int32,
         )
-        kinds = np.full(ne, _K_OTHER, np.int32)
-        vals = np.zeros(ne, np.int32)
-        chosen = np.zeros(ne, bool)
+        kinds = np.full(nep, _K_OTHER, np.int32)
+        vals = np.zeros(nep, np.int32)
+        chosen = np.zeros(nep, bool)
         if not self.general:  # register-workload history/property metadata
             for c, e in enumerate(self._envs):
                 if e.msg[0] == "put_ok":
@@ -1121,7 +1130,9 @@ class CompiledActorTensor(TensorModel):
         i32, u64 = jnp.int32, jnp.uint64
         B = rows.shape[0]
         NS, A, W = self.n_slots, self.max_actions, self.width
-        ne = len(self._envs)
+        # table env stride (padded: empty envelope universes carry a
+        # sentinel column; set where the tables are frozen, in _closure)
+        ne = self._ne_padded
         pk = self.pk
 
         slots = rows[:, self.pw :]  # [B, NS]
