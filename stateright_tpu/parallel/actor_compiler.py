@@ -820,7 +820,9 @@ class CompiledActorTensor(TensorModel):
         ]
         perms = list(permutations(range(n)))  # lexicographic mapping order
         rw = np.zeros((len(perms), real_u), np.int32)
-        ev = np.zeros((len(perms), max(1, len(self._envs))), np.int32)
+        # same padded env width as the transition tables (_ne_padded), so
+        # a padding-policy change cannot desync the symmetry gathers
+        ev = np.zeros((len(perms), self._ne_padded), np.int32)
         env_intern: dict = dict(self._env_code)
 
         def env_code_of(e: Envelope) -> int:

@@ -83,11 +83,13 @@ class FuzzModel(TensorBackedModel, ActorModel):
         return compile_actor_model(self)
 
 
-def _fuzz_model(seed: int, n_actors: int, network) -> FuzzModel:
+def _fuzz_model(
+    seed: int, n_actors: int, network, actor_cls=FuzzActor
+) -> FuzzModel:
     rng = random.Random(seed)
     m = FuzzModel(None, None)
     for i in range(n_actors):
-        m.actor(FuzzActor(rng, i, n_actors))
+        m.actor(actor_cls(rng, i, n_actors))
     m.init_network_(network)
     m.property(
         Expectation.SOMETIMES,
@@ -111,20 +113,50 @@ NETWORKS = {
 }
 
 
-# fast tier runs two seeds (0 = a typical chatty system; 4 = the empty
-# envelope universe that crashed device gathers); the rest join the daily
-# medium tier per the repo's tiering convention
-_FAST_SEEDS = (0, 4)
-_SEEDS = [
-    s if s in _FAST_SEEDS else pytest.param(s, marks=pytest.mark.medium)
-    for s in range(6)
-]
+class FuzzTimerActor(FuzzActor):
+    """FuzzActor plus a timer axis: boot may arm the timer; a timeout at
+    a non-final state may advance (and maybe send) and maybe re-arm —
+    still monotone, so still bounded."""
+
+    def __init__(self, rng: random.Random, me: int, n_actors: int):
+        super().__init__(rng, me, n_actors)
+        self.boot_timer = rng.random() < 0.7
+        # ttable[state] -> None (clear only) | (advance?, send | None, rearm?)
+        self.ttable = {}
+        for s in range(N_STATES - 1):
+            if rng.random() < 0.3:
+                self.ttable[s] = None
+            else:
+                send = None
+                if rng.random() < 0.5:
+                    send = (rng.randrange(n_actors), rng.randrange(ALPHABET))
+                advance = rng.random() < 0.8
+                # re-arming must imply advancing: a timeout that re-arms
+                # without changing state fires forever, adding one more
+                # envelope copy per firing — an infinite space
+                self.ttable[s] = (
+                    advance, send, advance and rng.random() < 0.6
+                )
+
+    def on_start(self, id: Id, out: Out):
+        state = super().on_start(id, out)
+        if self.boot_timer:
+            out.set_timer((1.0, 2.0))
+        return state
+
+    def on_timeout(self, id: Id, state, out: Out):
+        eff = self.ttable.get(state)
+        if eff is None:
+            return None  # the timeout still clears the timer bit
+        advance, send, rearm = eff
+        if send is not None and send[0] != self.me:
+            out.send(Id(send[0]), ("m", send[1]))
+        if rearm and state < N_STATES - 2:
+            out.set_timer((1.0, 2.0))
+        return state + 1 if advance and state < N_STATES - 1 else None
 
 
-@pytest.mark.parametrize("seed", _SEEDS)
-@pytest.mark.parametrize("net", sorted(NETWORKS))
-def test_fuzzed_system_host_equals_device(seed, net):
-    m = _fuzz_model(seed, n_actors=2 + seed % 2, network=NETWORKS[net]())
+def _assert_engine_parity(m, seed, net):
     tm = m.tensor_model()
     seen = crawl_and_check(m, tm)  # full-space per-state equivalence
     h = m.checker().spawn_bfs().join()
@@ -141,3 +173,45 @@ def test_fuzzed_system_host_equals_device(seed, net):
         c = build()
         assert c.unique_state_count() == h.unique_state_count(), (seed, net)
         assert sorted(c.discoveries()) == sorted(h.discoveries()), (seed, net)
+
+
+# fast tier runs two seeds (0 = a typical chatty system; 4 = the empty
+# envelope universe that crashed device gathers); the rest join the daily
+# medium tier per the repo's tiering convention
+_FAST_SEEDS = (0, 4)
+_SEEDS = [
+    s if s in _FAST_SEEDS else pytest.param(s, marks=pytest.mark.medium)
+    for s in range(6)
+]
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
+@pytest.mark.parametrize("net", sorted(NETWORKS))
+def test_fuzzed_system_host_equals_device(seed, net):
+    m = _fuzz_model(seed, n_actors=2 + seed % 2, network=NETWORKS[net]())
+    _assert_engine_parity(m, seed, net)
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_fuzzed_timer_system_host_equals_device(seed):
+    """The timer axis of the general fragment under fuzz: boot-armed
+    timers, timeout-driven advances/sends, re-arming — every engine
+    agrees with the host on the full space."""
+    m = _fuzz_model(
+        1000 + seed,
+        n_actors=2 + seed % 2,
+        network=Network.new_unordered_nonduplicating(),
+        actor_cls=FuzzTimerActor,
+    )
+    _assert_engine_parity(m, seed, "timer")
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_fuzzed_lossy_system_host_equals_device(seed):
+    """Drop actions under fuzz: a lossy duplicating network adds a Drop
+    per deliverable envelope; engines must agree on the enlarged space."""
+    m = _fuzz_model(
+        seed, n_actors=2, network=Network.new_unordered_duplicating()
+    )
+    m.lossy_network(True)
+    _assert_engine_parity(m, seed, "lossy-dup")
