@@ -1,0 +1,158 @@
+"""Register-fragment fuzz: seeded flaky servers under linearizability.
+
+The history codecs (closure verdict for ``put_count=1``, enumerated
+multi-op table for ``put_count=2``) are pinned on well-behaved protocols
+(ABD, paxos, single-copy).  This fuzzer generates servers with seeded
+*arbitrary* behavior — store-and-ack, ack-without-storing (a lying
+server), silently ignore — so some seeds genuinely violate
+linearizability, exercising the FALSE verdict path host=device.  For
+every seed: full-space per-state equivalence (``crawl_and_check``
+asserts the device ``linearizable`` mask equals the live tester's
+``is_consistent()`` on every reachable state) plus unique-count and
+discovery parity across engines.
+"""
+
+import random
+
+import pytest
+
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
+from stateright_tpu.actor.register import (
+    NULL_VALUE,
+    GetOk,
+    PutOk,
+    RegisterClient,
+    record_invocations,
+    record_returns,
+    value_chosen,
+)
+from stateright_tpu.core import Expectation
+from stateright_tpu.parallel.actor_compiler import compile_actor_model
+from stateright_tpu.parallel.tensor_model import TensorBackedModel
+from stateright_tpu.semantics import LinearizabilityTester, Register
+
+from test_paxos_tensor import crawl_and_check
+
+# put behaviors
+STORE_ACK = 0  # store the value, reply put_ok
+LIE_ACK = 1  # reply put_ok WITHOUT storing (linearizability hazard)
+IGNORE = 2
+
+
+class FlakyServer(Actor):
+    """Unreplicated register whose response behavior is drawn per
+    (message kind, whether a value is stored) from the seed."""
+
+    def __init__(self, rng: random.Random):
+        self.put_b = {
+            stored: rng.choices(
+                (STORE_ACK, LIE_ACK, IGNORE), weights=(6, 2, 2)
+            )[0]
+            for stored in (False, True)
+        }
+        self.get_b = {
+            stored: rng.random() < 0.85 for stored in (False, True)
+        }
+
+    def on_start(self, id: Id, out: Out):
+        return NULL_VALUE
+
+    def on_msg(self, id: Id, state, src: Id, msg, out: Out):
+        kind = msg[0]
+        stored = state != NULL_VALUE
+        if kind == "put":
+            b = self.put_b[stored]
+            if b == IGNORE:
+                return None
+            out.send(src, PutOk(msg[1]))
+            return msg[2] if b == STORE_ACK else state
+        if kind == "get":
+            if not self.get_b[stored]:
+                return None
+            out.send(src, GetOk(msg[1], state))
+            return state
+        return None
+
+
+class FuzzRegisterModel(TensorBackedModel, ActorModel):
+    def tensor_model(self):
+        return compile_actor_model(self)
+
+
+def _model(seed: int, servers: int, clients: int, put_count: int):
+    rng = random.Random(seed)
+    m = FuzzRegisterModel(
+        cfg=None, init_history=LinearizabilityTester(Register(NULL_VALUE))
+    )
+    for _ in range(servers):
+        m.actor(FlakyServer(rng))
+    for _ in range(clients):
+        m.actor(RegisterClient(put_count=put_count, server_count=servers))
+    m.init_network_(Network.new_unordered_nonduplicating())
+    m.property(
+        Expectation.ALWAYS,
+        "linearizable",
+        lambda model, s: s.history.is_consistent(),
+    )
+    m.property(Expectation.SOMETIMES, "value chosen", value_chosen)
+    m.record_msg_in(record_returns)
+    m.record_msg_out(record_invocations)
+    return m
+
+
+def _assert_parity(m, tag):
+    tm = m.tensor_model()
+    seen = crawl_and_check(m, tm)  # includes per-state linearizable mask
+    h = m.checker().spawn_bfs().join()
+    t = m.checker().spawn_tpu(sync=True, capacity=1 << 13)
+    # early exit lands at different granularity per engine, so compare
+    # discovery SETS (and witness validity), not counts, when a
+    # violation stops the run early
+    assert sorted(t.discoveries()) == sorted(h.discoveries()), tag
+    if "linearizable" not in h.discoveries():
+        assert (
+            h.unique_state_count()
+            == t.unique_state_count()
+            == len(seen)
+        ), tag
+    else:
+        final = t.discoveries()["linearizable"].final_state()
+        assert not final.history.is_consistent(), tag
+    return sorted(h.discoveries())
+
+
+_FAST_SEEDS = (0, 3)
+_SEEDS = [
+    s if s in _FAST_SEEDS else pytest.param(s, marks=pytest.mark.medium)
+    for s in range(6)
+]
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_fuzzed_flaky_register_put1(seed):
+    """Closure-strategy verdict under fuzz (put_count=1).  A seed may
+    legitimately discover nothing (servers that ignore everything);
+    the parity assertions inside are the test."""
+    _assert_parity(
+        _model(seed, servers=2, clients=2, put_count=1), ("put1", seed)
+    )
+
+
+@pytest.mark.parametrize("seed", _SEEDS)
+def test_fuzzed_flaky_register_put2(seed):
+    """Multi-op table verdict under fuzz (put_count=2)."""
+    _assert_parity(
+        _model(seed, servers=2, clients=2, put_count=2), ("put2", seed)
+    )
+
+
+def test_fuzz_space_finds_both_verdicts():
+    """Sanity on the fuzz distribution itself: across the seeds, at least
+    one configuration violates linearizability (the FALSE path is really
+    exercised) and at least one does not."""
+    verdicts = set()
+    for seed in range(6):
+        m = _model(seed, servers=2, clients=2, put_count=1)
+        h = m.checker().spawn_bfs().join()
+        verdicts.add("linearizable" in h.discoveries())
+    assert verdicts == {True, False}
