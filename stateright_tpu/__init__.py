@@ -31,6 +31,7 @@ from .checker import (
     StateRecorder,
 )
 from .fingerprint import fingerprint, stable_hash
+from .analysis import AuditError, AuditFinding, AuditReport, audit_model
 
 __version__ = "0.1.0"
 
@@ -45,4 +46,8 @@ __all__ = [
     "StateRecorder",
     "fingerprint",
     "stable_hash",
+    "AuditError",
+    "AuditFinding",
+    "AuditReport",
+    "audit_model",
 ]

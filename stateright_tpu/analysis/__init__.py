@@ -1,0 +1,32 @@
+"""Preflight static analysis for device-checked models.
+
+Three passes over a model *before* any device launch — the static
+counterpart to the engines' runtime poison/growth diagnostics:
+
+ - :mod:`.jaxpr_audit` — abstractly trace a ``TensorModel``'s
+   ``step_rows``/``property_masks`` and walk the jaxpr for purity, dtype,
+   shape-contract, and retrace-stability violations (plus a FLOPs/bytes
+   perf preflight);
+ - :mod:`.handler_lint` — AST-lint actor handlers for nondeterminism and
+   in-place mutation, and probe one bounded step of the tabulation
+   closure for unbounded (ballot-style) field domains;
+ - :mod:`.audit` — the driver: twin resolution, config-drift checks, and
+   the per-model report cache.
+
+Surfaces: ``model.checker().audit()`` (and the automatic ``spawn_tpu``
+preflight — errors abort before launch, ``skip_audit()`` overrides),
+``python -m stateright_tpu.models._cli audit`` over the example fleet,
+and the Explorer's ``/.status``.  Rule catalogue: ``docs/analysis.md``.
+"""
+
+from .audit import audit_model, config_signature
+from .report import AuditError, AuditFinding, AuditReport, Severity
+
+__all__ = [
+    "AuditError",
+    "AuditFinding",
+    "AuditReport",
+    "Severity",
+    "audit_model",
+    "config_signature",
+]

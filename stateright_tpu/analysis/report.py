@@ -1,0 +1,169 @@
+"""Structured audit results: findings, severities, and the report surface.
+
+Every static-analysis pass (``jaxpr_audit``, ``handler_lint``, the config
+drift check) produces :class:`AuditFinding` values; :class:`AuditReport`
+aggregates them per model with run-quality metrics (per-row FLOPs/bytes,
+visited-table occupancy).  The report is the single artifact shared by the
+``CheckerBuilder`` preflight (errors abort before device launch), the
+``audit`` CLI verb, and the Explorer's ``/.status`` endpoint.
+
+Rule-id namespaces (full catalogue: ``docs/analysis.md``):
+
+ - ``JX*`` — jaxpr kernel audit (``analysis/jaxpr_audit.py``)
+ - ``AH*`` — actor-handler lint (``analysis/handler_lint.py``)
+ - ``CF*`` — builder/config lifecycle checks (``analysis/audit.py``)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class Severity:
+    """Ordered severity levels.  ``ERROR`` findings abort ``spawn_tpu``
+    preflight; ``WARNING`` findings print once; ``INFO`` findings are
+    advisory (perf estimates, downgraded rules)."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    _ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+    @classmethod
+    def rank(cls, sev: str) -> int:
+        return cls._ORDER.get(sev, 3)
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    """One diagnostic: a stable rule id, a severity, where, and why."""
+
+    rule_id: str
+    severity: str
+    location: str  # e.g. "step_rows", "actor[2].on_msg:14", "builder"
+    message: str
+
+    def format(self) -> str:
+        return f"{self.severity.upper():7s} {self.rule_id} {self.location}: {self.message}"
+
+
+@dataclass
+class AuditReport:
+    """All findings for one model, plus perf/diagnostic metrics.
+
+    ``metrics`` carries non-finding diagnostics: per-kernel FLOPs/bytes
+    estimates (``metrics["step_rows"]``) and, once a device run exists,
+    the visited-table bucket-occupancy counters (``metrics["table"]``,
+    from ``ops/buckets.occupancy_stats``)."""
+
+    model: str = ""
+    findings: list = field(default_factory=list)
+    metrics: dict = field(default_factory=dict)
+
+    # -- construction --------------------------------------------------------
+
+    def copy(self) -> "AuditReport":
+        """Shallow copy with its own findings list and metrics dict.
+        Findings are frozen and shared; the metrics dict must be private
+        per model — engines fold run diagnostics (table occupancy) into
+        it, and a shared dict would leak one run's numbers into every
+        same-config model's report."""
+        return AuditReport(
+            model=self.model,
+            findings=list(self.findings),
+            metrics=dict(self.metrics),
+        )
+
+    def add(self, rule_id: str, severity: str, location: str, message: str) -> None:
+        self.findings.append(AuditFinding(rule_id, severity, location, message))
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def errors(self) -> list:
+        return [f for f in self.findings if f.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> list:
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    @property
+    def infos(self) -> list:
+        return [f for f in self.findings if f.severity == Severity.INFO]
+
+    @property
+    def ok(self) -> bool:
+        """No errors (warnings/infos permitted)."""
+        return not self.errors
+
+    def by_rule(self, rule_id: str) -> list:
+        return [f for f in self.findings if f.rule_id == rule_id]
+
+    def rule_ids(self) -> set:
+        return {f.rule_id for f in self.findings}
+
+    # -- rendering -----------------------------------------------------------
+
+    def format(self, min_severity: str = Severity.INFO) -> str:
+        """Human-readable report, most severe first."""
+        cut = Severity.rank(min_severity)
+        shown = sorted(
+            (f for f in self.findings if Severity.rank(f.severity) <= cut),
+            key=lambda f: (Severity.rank(f.severity), f.rule_id, f.location),
+        )
+        head = (
+            f"audit {self.model}: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s), {len(self.infos)} info"
+        )
+        lines = [head] + ["  " + f.format() for f in shown]
+        if "step_rows" in self.metrics:
+            m = self.metrics["step_rows"]
+            lines.append(
+                "  perf: step_rows ~{flops:.0f} flops/row, "
+                "~{bytes:.0f} bytes/row, {eqns} eqns".format(
+                    flops=m.get("flops_per_row", 0.0),
+                    bytes=m.get("bytes_per_row", 0.0),
+                    eqns=m.get("eqns", 0),
+                )
+            )
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        """JSON-safe dict for ``/.status`` and tooling."""
+        return {
+            "model": self.model,
+            "ok": self.ok,
+            "errors": len(self.errors),
+            "warnings": len(self.warnings),
+            "infos": len(self.infos),
+            "findings": [
+                {
+                    "rule_id": f.rule_id,
+                    "severity": f.severity,
+                    "location": f.location,
+                    "message": f.message,
+                }
+                for f in self.findings
+            ],
+            "metrics": self.metrics,
+        }
+
+
+class AuditError(RuntimeError):
+    """Preflight audit found errors; raised by ``spawn_tpu`` before any
+    device work happens.  Carries the full report; silence deliberately
+    with ``CheckerBuilder.skip_audit()``."""
+
+    def __init__(self, report: AuditReport, context: Optional[str] = None):
+        self.report = report
+        prefix = f"{context}: " if context else ""
+        super().__init__(
+            prefix
+            + "preflight audit failed (skip_audit() to override)\n"
+            + report.format(min_severity=Severity.WARNING)
+        )

@@ -33,6 +33,7 @@ class CheckerBuilder:
         self.thread_count: int = 1
         self.visitor_obj: Optional[CheckerVisitor] = None
         self.timeout_secs: Optional[float] = None
+        self._audit_skip = False
 
     # -- configuration -------------------------------------------------------
 
@@ -65,6 +66,55 @@ class CheckerBuilder:
     def timeout(self, secs: float) -> "CheckerBuilder":
         self.timeout_secs = secs
         return self
+
+    # -- static preflight audit (stateright_tpu/analysis/) -------------------
+
+    def audit(self, *, deep: bool = True) -> "object":
+        """Run the static auditor over the model and return the
+        :class:`~stateright_tpu.analysis.AuditReport` — jaxpr kernel audit
+        of the tensor twin, actor-handler lint, config-drift checks
+        (rule catalogue: ``docs/analysis.md``).  ``deep=True`` adds the
+        bounded closure-domain probe and the fresh-twin drift re-resolve."""
+        from ..analysis import audit_model
+
+        return audit_model(self.model, deep=deep)
+
+    def skip_audit(self) -> "CheckerBuilder":
+        """Escape hatch: disable the automatic ``spawn_tpu`` preflight
+        audit for this builder (e.g. to reproduce a flagged defect on
+        device, or when a rule false-positives on exotic kernels)."""
+        self._audit_skip = True
+        return self
+
+    def _preflight_audit(self) -> None:
+        """Audit before any device launch: errors abort (raising
+        :class:`~stateright_tpu.analysis.AuditError`), warnings print once
+        per model.  Disabled by :meth:`skip_audit` or the
+        ``STATERIGHT_TPU_SKIP_AUDIT=1`` env knob."""
+        import os
+
+        if self._audit_skip or os.environ.get("STATERIGHT_TPU_SKIP_AUDIT") == "1":
+            return
+        from ..analysis import AuditError, Severity, audit_model
+
+        try:
+            report = audit_model(self.model, deep=False)
+        except Exception:  # noqa: BLE001 - the audit must never mask the
+            return  # engine's own (more specific) spawn-time error surface
+        if report.errors:
+            raise AuditError(
+                report, context=f"spawn_tpu({type(self.model).__name__})"
+            )
+        if report.warnings and not getattr(
+            self.model, "_audit_warn_printed", False
+        ):
+            try:
+                object.__setattr__(self.model, "_audit_warn_printed", True)
+            except Exception:  # noqa: BLE001 - __slots__ models
+                pass
+            print(
+                report.format(min_severity=Severity.WARNING), file=sys.stderr
+            )
 
     # -- strategies ----------------------------------------------------------
 
@@ -192,7 +242,12 @@ class CheckerBuilder:
 
         Pass ``devices=N`` (or ``mesh=...``) to shard the wavefront over a
         device mesh with all-to-all fingerprint routing
-        (``stateright_tpu/parallel/sharded.py``)."""
+        (``stateright_tpu/parallel/sharded.py``).
+
+        A static preflight audit runs first (``docs/analysis.md``): audit
+        errors abort here, before any device work; silence deliberately
+        with :meth:`skip_audit`."""
+        self._preflight_audit()
         devices = kw.pop("devices", None)
         if devices is not None and devices != 1:
             kw.setdefault("n_devices", devices)

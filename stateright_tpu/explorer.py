@@ -122,6 +122,16 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
                 encoded.get(prop.name),
             ]
         )
+    # Last preflight audit report (stateright_tpu/analysis/): populated by
+    # the spawn_tpu preflight or an explicit builder.audit(); None when no
+    # audit ran (e.g. the BFS strategy on an un-audited model).  Device
+    # runs additionally expose the visited-table bucket-occupancy counters
+    # (ops/buckets.occupancy_stats) once the run has results.
+    audit = getattr(model, "_audit_report", None)
+    table = None
+    occ = getattr(checker, "occupancy_stats", None)
+    if occ is not None:
+        table = occ()
     return {
         "done": checker.is_done(),
         "model": type(model).__name__,
@@ -129,6 +139,8 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
         "unique_state_count": checker.unique_state_count(),
         "properties": props,
         "recent_path": snapshot.recent_path,
+        "audit": audit.to_json() if audit is not None else None,
+        "table": table,
     }
 
 

@@ -2,14 +2,21 @@
 e.g. ``examples/paxos.rs:314-395``): subcommands ``check [args]``,
 ``check-sym``, ``explore [addr]``, ``spawn``, with positional arguments.
 Beyond the reference's verbs: ``check-tpu`` / ``check-sym-tpu`` (device
-engines) and ``check-auto`` (measured engine selection,
-``CheckerBuilder.spawn_auto``)."""
+engines), ``check-auto`` (measured engine selection,
+``CheckerBuilder.spawn_auto``), and ``audit`` (the static preflight
+auditor, ``stateright_tpu/analysis/``).
+
+Fleet mode — ``python -m stateright_tpu.models._cli audit [MODULE...]`` —
+audits every shipped example (each module exposes ``_audit_models()``),
+printing one report per configuration and exiting non-zero on any
+error-severity finding; CI gates on it.
+"""
 
 from __future__ import annotations
 
 import os
 import sys
-from typing import Callable, Optional
+from typing import Callable, Iterable, Optional
 
 
 def run_cli(
@@ -21,6 +28,7 @@ def run_cli(
     check_auto: Optional[Callable[[list], None]] = None,
     explore: Optional[Callable[[list], None]] = None,
     spawn: Optional[Callable[[list], None]] = None,
+    audit: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -40,10 +48,88 @@ def run_cli(
         explore(rest)
     elif cmd == "spawn" and spawn is not None:
         spawn(rest)
+    elif cmd == "audit" and audit is not None:
+        audit(rest)
     else:
         print("USAGE:")
         print(usage)
+        if audit is not None:
+            print("  <example> audit    # static preflight audit "
+                  "(docs/analysis.md)")
 
 
 def default_threads() -> int:
     return os.cpu_count() or 1
+
+
+# -- audit verb --------------------------------------------------------------
+
+
+def audit_and_report(
+    models: Iterable[tuple], stream=None, deep: bool = True
+) -> bool:
+    """Audit ``(label, model)`` pairs, print one report each; True iff no
+    error-severity findings anywhere."""
+    from ..analysis import audit_model
+
+    stream = stream or sys.stdout
+    ok = True
+    for label, model in models:
+        report = audit_model(model, deep=deep)
+        print(f"--- {label}", file=stream)
+        print(report.format(), file=stream)
+        ok = ok and report.ok
+    return ok
+
+
+def make_audit_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as an ``audit``
+    CLI verb that exits 1 on error findings."""
+
+    def _audit(rest: list) -> None:
+        if not audit_and_report(factory(rest)):
+            raise SystemExit(1)
+
+    return _audit
+
+
+def fleet_audit(names: Optional[list] = None, stream=None) -> int:
+    """Audit the whole example fleet (or just ``names``); 0 iff clean.
+    Modules without an ``_audit_models`` hook are reported and skipped."""
+    import importlib
+
+    from . import __all__ as all_names
+
+    stream = stream or sys.stdout
+    ok = True
+    for name in names or list(all_names):
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+        factory = getattr(mod, "_audit_models", None)
+        if factory is None:
+            # a FAILURE, not a skip: the gate exists to keep every shipped
+            # example audited — a new example without the hook would
+            # otherwise silently shrink coverage while CI stays green
+            print(
+                f"--- {name}: FAILED — no _audit_models hook (add one so "
+                "the fleet gate covers this example)",
+                file=stream,
+            )
+            ok = False
+            continue
+        ok = audit_and_report(factory([]), stream=stream) and ok
+    print("audit fleet: " + ("CLEAN" if ok else "FAILED"), file=stream)
+    return 0 if ok else 1
+
+
+def main(argv: Optional[list] = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "audit":
+        raise SystemExit(fleet_audit(argv[1:]))
+    print("USAGE:")
+    print("  python -m stateright_tpu.models._cli audit [MODULE...]")
+    print("    static preflight audit over the example fleet "
+          "(docs/analysis.md)")
+
+
+if __name__ == "__main__":
+    main()

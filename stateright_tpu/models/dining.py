@@ -26,7 +26,7 @@ from ..actor import Actor, ActorModel, Id, Network, Out
 from ..actor.device_props import exists_actor, forall_actors
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 HUNGRY, HAS_LEFT, DONE = 0, 1, 2
 
@@ -128,6 +128,13 @@ def dining_model(n: int = 3, network: Optional[Network] = None) -> ActorModel:
     return m
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    n = int(rest[0]) if rest else 3
+    return [(f"dining n={n}", dining_model(n))]
+
+
 def main(argv=None) -> None:
     def parse(rest):
         return int(rest[0]) if rest else 3
@@ -177,6 +184,7 @@ def main(argv=None) -> None:
         check_tpu=check_tpu,
         check_auto=check_auto,
         explore=explore,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

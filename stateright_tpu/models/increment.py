@@ -12,7 +12,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 from .. import Model, Property
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 
 @dataclass(frozen=True)
@@ -59,6 +59,13 @@ class Increment(Model):
         ]
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    n = int(rest[0]) if rest else 2
+    return [(f"increment threads={n}", Increment(n))]
+
+
 def main(argv=None):
     def check(rest):
         n = int(rest[0]) if rest else 3
@@ -93,6 +100,7 @@ def main(argv=None):
         check_sym=check_sym,
         check_auto=check_auto,
         explore=explore,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

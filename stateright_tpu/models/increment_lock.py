@@ -10,7 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 from .. import Model, Property
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 
 @dataclass(frozen=True)
@@ -72,6 +72,13 @@ class IncrementLock(Model):
         ]
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    n = int(rest[0]) if rest else 2
+    return [(f"increment_lock threads={n}", IncrementLock(n))]
+
+
 def main(argv=None):
     def check(rest):
         n = int(rest[0]) if rest else 3
@@ -105,6 +112,7 @@ def main(argv=None):
         check_sym=check_sym,
         check_auto=check_auto,
         explore=explore,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

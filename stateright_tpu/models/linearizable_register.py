@@ -30,7 +30,7 @@ from ..actor.register import (
 )
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 
 def Query(req_id):
@@ -258,6 +258,13 @@ def abd_model(
     return m
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    c = int(rest[0]) if rest else 2
+    return [(f"linearizable_register clients={c} servers=2", abd_model(c, 2))]
+
+
 def main(argv=None):
     def check(rest):
         client_count = int(rest[0]) if rest else 2
@@ -333,6 +340,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

@@ -36,7 +36,7 @@ from ..actor.register import (
 )
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 def _ballot_zero() -> tuple:
     return (0, Id(0))
@@ -275,6 +275,13 @@ def paxos_model(
     return m
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    c = int(rest[0]) if rest else 2
+    return [(f"paxos clients={c} servers=3", paxos_model(c, 3))]
+
+
 def main(argv=None):
     def check(rest):
         client_count = int(rest[0]) if rest else 2
@@ -352,6 +359,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

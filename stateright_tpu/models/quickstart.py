@@ -206,6 +206,20 @@ def vector_clock_model() -> ActorModel:
     return m
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (the fleet runner,
+    ``_cli.fleet_audit``).  The Lamport clock model is expected to carry
+    an AH205 finding: logical clocks grow without bound — exactly the
+    growing-domain pattern the rule exists for (the model itself is
+    bounded by ``within_boundary``, which device compilation would still
+    need as a ``state_bound``)."""
+    return [
+        ("quickstart sliding_puzzle", SlidingPuzzle()),
+        ("quickstart lamport_clocks", clock_model()),
+        ("quickstart vector_clocks", vector_clock_model()),
+    ]
+
+
 def main() -> None:
     path = solve_puzzle()
     moves = path.actions()

@@ -34,7 +34,7 @@ from ..actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
 from ..actor.device_props import exists_actor, forall_actor_pairs
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -197,6 +197,13 @@ def raft_model(
 RAFT3_SYM_SHARDED_BY_WIDTH = {1: 2926, 2: 2960, 4: 3010, 8: 3015}
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    n = int(rest[0]) if rest else 3
+    return [(f"raft servers={n} max_term=2", raft_model(n))]
+
+
 def main(argv=None) -> None:
     def parse(rest):
         n = int(rest[0]) if rest else 3
@@ -296,6 +303,7 @@ def main(argv=None) -> None:
         check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

@@ -26,7 +26,7 @@ from ..actor.register import (
 )
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 
 class SingleCopyServer(Actor):
@@ -85,6 +85,13 @@ def single_copy_model(
     m.record_msg_in(record_returns)
     m.record_msg_out(record_invocations)
     return m
+
+
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    c = int(rest[0]) if rest else 1
+    return [(f"single_copy_register clients={c}", single_copy_model(c))]
 
 
 def main(argv=None):
@@ -155,6 +162,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

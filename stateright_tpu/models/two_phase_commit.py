@@ -24,7 +24,7 @@ from typing import Optional
 from .. import Model, Property
 from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
 from ..symmetry import RewritePlan
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 # RM states, ordered so sorting gives a canonical symmetry representative
 WORKING = "working"
@@ -363,6 +363,13 @@ class TwoPhaseTensor(TensorModel):
         return jnp.stack([all_aborted, all_committed, consistent], axis=-1)
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    rm_count = int(rest[0]) if rest else 3
+    return [(f"two_phase_commit rm={rm_count}", TwoPhaseSys(rm_count))]
+
+
 def main(argv=None):
     def check(rest):
         rm_count = int(rest[0]) if rest else 2
@@ -421,6 +428,7 @@ def main(argv=None):
         check_sym_tpu=check_sym_tpu,
         check_auto=check_auto,
         explore=explore,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

@@ -36,7 +36,7 @@ from ..fingerprint import stable_hash
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, WORegister
 from ..symmetry import RewritePlan, rewrite_value
-from ._cli import default_threads, run_cli
+from ._cli import default_threads, make_audit_cmd, run_cli
 
 
 class WOServer(Actor):
@@ -114,6 +114,13 @@ def wo_register_model(
     return m
 
 
+def _audit_models(rest=()):
+    """Default configurations for the static auditor (``audit`` verb and
+    the fleet runner, ``_cli.fleet_audit``)."""
+    c = int(rest[0]) if rest else 1
+    return [(f"write_once_register clients={c} servers=2", wo_register_model(c, 2))]
+
+
 def main(argv=None):
     def parse(rest):
         client_count = int(rest[0]) if rest else 2
@@ -180,6 +187,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
+        audit=make_audit_cmd(_audit_models),
         argv=argv,
     )
 

@@ -246,6 +246,51 @@ def bucket_insert(
     return table_fp, table_payload, sel, n_new, overflow, cand_overflow
 
 
+def occupancy_stats(table_fp) -> dict:
+    """Bucket-occupancy counters for a visited table (numpy, JSON-safe).
+
+    The engines' growth protocol keys on load factor and single-bucket
+    overflow, but the *distribution* was never observable — and VERDICT.md
+    records an open anomaly where runs grow tables earlier than the ≤25%
+    Poisson model predicts.  This is the first diagnostic handle on it:
+    exposed via ``WavefrontChecker.occupancy_stats()``, the Explorer's
+    ``/.status`` (``"table"``), and the audit report metrics.
+
+    ``histogram[k]`` counts buckets holding exactly ``k`` fingerprints;
+    a heavy tail vs Poisson(λ = occupied/nbuckets) means the low bits of
+    the fingerprint mix are clustering.
+    """
+    t = np.asarray(table_fp).reshape(-1, SLOTS)
+    per_bucket = (t != EMPTY).sum(axis=1)
+    nbuckets = int(t.shape[0])
+    occupied = int(per_bucket.sum())
+    hist = np.bincount(per_bucket, minlength=SLOTS + 1)
+    lam = occupied / nbuckets if nbuckets else 0.0
+    # Poisson tail mass at/over SLOTS for the observed load — the model the
+    # ≤25%-load growth policy assumes; compare with full_buckets/nbuckets
+    tail = 0.0
+    if lam > 0:
+        import math
+
+        p = math.exp(-lam)
+        cum = p
+        for k in range(1, SLOTS):
+            p *= lam / k
+            cum += p
+        tail = max(0.0, 1.0 - cum)
+    return {
+        "nbuckets": nbuckets,
+        "slots_per_bucket": SLOTS,
+        "occupied": occupied,
+        "load_factor": occupied / (nbuckets * SLOTS) if nbuckets else 0.0,
+        "mean_bucket": lam,
+        "max_bucket": int(per_bucket.max()) if nbuckets else 0,
+        "full_buckets": int((per_bucket >= SLOTS).sum()),
+        "poisson_full_expect": tail * nbuckets,
+        "histogram": hist.tolist(),
+    }
+
+
 def host_bucket_rehash(
     table_fp: np.ndarray, table_payload: np.ndarray, new_nbuckets: int
 ):

@@ -111,6 +111,16 @@ class TensorBackedModel:
         if tm is self._TENSOR_UNRESOLVED:
             tm = self.tensor_model()
             object.__setattr__(self, "_tensor_model_cache", tm)
+            # Snapshot the configuration surface at resolution time: the
+            # preflight auditor compares it against the live config and
+            # flags drift (direct attribute writes bypass the builder's
+            # _config_mutated hook entirely) as CF301 *before* a run can
+            # mix fingerprint schemes.  See analysis/audit.py.
+            from ..analysis.audit import config_signature
+
+            object.__setattr__(
+                self, "_tensor_config_sig", config_signature(self)
+            )
         object.__setattr__(self, "_tensor_fp_used", True)
         return tm
 
