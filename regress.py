@@ -1,0 +1,134 @@
+"""Perf-regression gate: a fresh bench run's summary vs the stored baseline.
+
+The round-5 failure mode this closes: ``BENCH_r05.json`` carried round 4's
+266.7k states/s forward under the validated-fallback and nothing failed —
+the stale number masqueraded as the round's result.  This gate makes
+staleness and regressions LOUD:
+
+    python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
+                      [--tolerance=0.85] [--allow-stale]
+
+``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
+artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
+(a driver ``BENCH_rNN.json`` whose ``parsed`` field holds the headline
+object works too: the object is unwrapped).
+
+Checks, in order:
+
+ 1. **Freshness** — ``fresh`` must be true: a run that only replayed
+    ``BENCH_VALIDATED.json`` is not a measurement.  Exit 2 (unless
+    ``--allow-stale``, for comparing two stored artifacts).
+ 2. **Throughput** — every ``tpu_*_states_per_sec`` key present in BOTH
+    the run and the baseline must reach ``tolerance`` × baseline
+    (default 0.85: the r4 sweep put same-config run-to-run spread within
+    ±5%, so −15% is a real regression, not noise).  Exit 1 on any miss.
+
+The verdict prints as one JSON line: ``{ok, fresh, regressed: [...],
+improved: [...], checked: N}`` — ``regressed`` entries carry the config
+tag, both rates, and the ratio.  Exit 0 only when fresh and clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_RUN = os.path.join(_HERE, "docs", "bench-last-details.json")
+DEFAULT_BASELINE = os.path.join(_HERE, "BENCH_VALIDATED.json")
+DEFAULT_TOLERANCE = 0.85
+
+
+def load_run(path: str) -> dict:
+    """A bench summary object from a details file or a driver artifact
+    (``{"parsed": {...}}`` wrappers are unwrapped)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return doc
+
+
+def compare(run: dict, baseline: dict,
+            tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Pure comparison (no I/O): the verdict dict described in the module
+    docstring.  ``ok`` is freshness AND no regression."""
+    regressed, improved, checked = [], [], 0
+    for key, base in sorted(baseline.items()):
+        if not key.endswith("_states_per_sec") or not key.startswith("tpu_"):
+            continue
+        cur = run.get(key)
+        if cur is None or not base:
+            continue
+        checked += 1
+        ratio = round(cur / base, 3)
+        entry = {"config": key, "run": cur, "baseline": base, "ratio": ratio}
+        if cur < tolerance * base:
+            regressed.append(entry)
+        elif cur > base:
+            improved.append(entry)
+    fresh = bool(run.get("fresh"))
+    return {
+        "ok": fresh and not regressed,
+        "fresh": fresh,
+        "tolerance": tolerance,
+        "checked": checked,
+        "regressed": regressed,
+        "improved": improved,
+    }
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
+    tolerance, allow_stale = DEFAULT_TOLERANCE, False
+    pos = []
+    for a in argv:
+        if a.startswith("--baseline="):
+            baseline_path = a[len("--baseline="):]
+        elif a.startswith("--tolerance="):
+            tolerance = float(a[len("--tolerance="):])
+        elif a == "--allow-stale":
+            allow_stale = True
+        else:
+            pos.append(a)
+    if pos:
+        run_path = pos[0]
+    try:
+        run = load_run(run_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(json.dumps({"ok": False, "error": f"cannot load run: {e}"}))
+        return 2
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(json.dumps({"ok": False,
+                          "error": f"cannot load baseline: {e}"}))
+        return 2
+    verdict = compare(run, baseline, tolerance)
+    stale_note = run.get("stale")
+    if stale_note:
+        verdict["stale"] = stale_note
+    print(json.dumps(verdict))
+    if not verdict["fresh"] and not allow_stale:
+        sys.stderr.write(
+            "regress: RUN IS STALE — the artifact replays "
+            "BENCH_VALIDATED.json, it does not measure this round's "
+            "engine. Refusing to validate it.\n"
+        )
+        return 2
+    if verdict["regressed"]:
+        sys.stderr.write(
+            f"regress: {len(verdict['regressed'])} config(s) below "
+            f"{tolerance}x of the stored baseline (see stdout JSON)\n"
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ class CheckerBuilder:
         self.visitor_obj: Optional[CheckerVisitor] = None
         self.timeout_secs: Optional[float] = None
         self._audit_skip = False
+        self.telemetry_opts: Optional[dict] = None
 
     # -- configuration -------------------------------------------------------
 
@@ -66,6 +67,65 @@ class CheckerBuilder:
     def timeout(self, secs: float) -> "CheckerBuilder":
         self.timeout_secs = secs
         return self
+
+    def telemetry(
+        self,
+        enabled: bool = True,
+        *,
+        capacity: int = 4096,
+        occupancy_every: int = 0,
+        profile_steps: int = 0,
+        profile_dir: Optional[str] = None,
+    ) -> "CheckerBuilder":
+        """Attach a flight recorder to the spawned checker
+        (``stateright_tpu/telemetry/``; schema in ``docs/telemetry.md``).
+
+        Every strategy then streams one structured record per step — device
+        engines per host sync, host engines per job block / mp round — into
+        a bounded ring (``capacity`` records) exposed as
+        ``checker.flight_recorder`` (JSONL/Chrome-trace export, the
+        Explorer's ``/.metrics``, ``bench.py`` summaries).
+
+        ``occupancy_every=N`` additionally samples the visited table's
+        bucket-occupancy distribution every N host syncs on the device
+        engines, plus a closing ``final`` sample — each a D2H table pull,
+        priced in the recorder's transfer counters.  Growth boundaries are
+        always sampled for free (the table is host-side there anyway), as
+        is the sharded engine's run end (it materializes the table
+        host-side regardless); the single-device engine keeps its final
+        table on device, so its run-end sample happens only under
+        ``occupancy_every``.
+
+        ``profile_steps=N`` arms a scoped ``jax.profiler`` trace of the
+        first N hot steps into ``profile_dir`` (device engines only).
+
+        Telemetry off (the default) is exactly the pre-telemetry engine:
+        zero ops added to the step jaxpr, no recorder allocated."""
+        if not enabled:
+            self.telemetry_opts = None
+            return self
+        self.telemetry_opts = {
+            "capacity": capacity,
+            "occupancy_every": occupancy_every,
+            "profile_steps": profile_steps,
+            "profile_dir": profile_dir,
+        }
+        return self
+
+    def _make_recorder(self, engine: str):
+        """FlightRecorder per the builder's telemetry options (None when
+        telemetry is off) — shared by every spawn path."""
+        if self.telemetry_opts is None:
+            return None
+        from ..telemetry import FlightRecorder
+
+        return FlightRecorder(
+            capacity=self.telemetry_opts["capacity"],
+            meta={
+                "engine": engine,
+                "model": type(self.model).__name__,
+            },
+        )
 
     # -- static preflight audit (stateright_tpu/analysis/) -------------------
 
@@ -279,6 +339,9 @@ class Checker:
     (reference ``checker.rs:185-338``)."""
 
     model: Model
+    # run telemetry (stateright_tpu/telemetry/): a FlightRecorder when the
+    # builder requested .telemetry(), else None on every strategy
+    flight_recorder = None
 
     # -- strategy-provided ---------------------------------------------------
 

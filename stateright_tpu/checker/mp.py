@@ -91,6 +91,11 @@ class MpBfsChecker(ParentPointerTrace, Checker):
     def __init__(self, options: CheckerBuilder, processes: Optional[int] = None):
         self.model = options.model
         self._props = list(self.model.properties())
+        # flight recorder: workers cannot share it across the fork, so
+        # worker 0 logs one (wall-time, frontier, unique, states) tuple per
+        # round — from the SAME barrier snapshot every worker agrees on —
+        # and the parent replays the history as "step" records post-merge
+        self.flight_recorder = options._make_recorder("mp")
         # an EXPLICIT processes count wins verbatim (processes=1 is a valid
         # single-worker debugging run); only the unset case falls through to
         # threads(N) and then to all cores
@@ -169,7 +174,7 @@ class MpBfsChecker(ParentPointerTrace, Checker):
         # both discovered a property, the surviving witness fingerprint (and
         # therefore the reconstructed trace) must not depend on OS scheduling
         for who in sorted(results):
-            visited, disc, count, _ = results[who]
+            visited, disc, count, _, _ = results[who]
             for fp, pfp in visited.values():
                 self._generated[fp] = pfp
             for name, fp in disc.items():
@@ -177,6 +182,15 @@ class MpBfsChecker(ParentPointerTrace, Checker):
             self._count += count
         for w in workers:
             w.join()
+        if self.flight_recorder is not None and 0 in results:
+            rec = self.flight_recorder
+            for rnd, (t_abs, frontier, unique, count) in enumerate(
+                results[0][4]
+            ):
+                rec.step(
+                    engine="mp", states=count, unique=unique,
+                    frontier=frontier, round=rnd, t=rec.rel(t_abs),
+                )
         if want_visits:
             self._replay_visits(options.visitor_obj, results)
 
@@ -280,6 +294,10 @@ def _worker_loop(
     # per-round visit order (fps only — the parent replays them through
     # the visitor after the merge; see MpBfsChecker._replay_visits)
     visit_log: list[list[int]] = []
+    # per-round (wall, frontier, unique, states) history for the parent's
+    # flight recorder; worker 0 only (every worker computes the same
+    # barrier snapshot, so one copy suffices)
+    round_log: list[tuple] = []
 
     rnd = 0
     while True:
@@ -346,6 +364,11 @@ def _worker_loop(
         barrier.wait()
         tot_frontier = sum(stats[j * _NCOL + _FRONTIER] for j in range(n))
         tot_unique = sum(stats[j * _NCOL + _UNIQUE] for j in range(n))
+        if me == 0:
+            tot_count = sum(stats[j * _NCOL + _COUNT] for j in range(n))
+            round_log.append(
+                (time.monotonic(), tot_frontier, tot_unique, tot_count)
+            )
         or_mask = 0
         stop = False
         for j in range(n):
@@ -365,7 +388,8 @@ def _worker_loop(
         rnd += 1
 
     result_q.put(
-        ("done", me, (visited, discoveries, local_count, visit_log))
+        ("done", me, (visited, discoveries, local_count, visit_log,
+                      round_log))
     )
 
 

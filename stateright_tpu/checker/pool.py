@@ -38,8 +38,13 @@ class _JobMarket:
 class WorkerPoolChecker(Checker):
     """Checker strategy backed by a pool of work-sharing threads."""
 
+    _telemetry_tag = "pool"  # overridden: "bfs" / "dfs"
+
     def _start_pool(self, options: CheckerBuilder, initial_job) -> None:
         self._options = options
+        # flight recorder (stateright_tpu/telemetry/): one "step" record per
+        # processed job block, from whichever worker thread ran it
+        self.flight_recorder = options._make_recorder(self._telemetry_tag)
         self._count_lock = threading.Lock()
         self._state_count_shared = 0
         self._stop = threading.Event()
@@ -107,6 +112,13 @@ class WorkerPoolChecker(Checker):
                 if not pending:
                     continue
             self._check_block(pending)
+            if self.flight_recorder is not None:
+                self.flight_recorder.step(
+                    engine=self._telemetry_tag,
+                    states=self._state_count_shared,
+                    unique=self.unique_state_count(),
+                    queue=len(pending),
+                )
             if self._deadline is not None and time.monotonic() > self._deadline:
                 # "timed out" means CUT SHORT: a run whose last block
                 # exhausted the space just past the deadline completed —

@@ -12,6 +12,10 @@ HTTP surface mirrors the reference server (``src/checker/explorer.rs``):
    fingerprint, svg}``; ignored (no-op) actions are returned with no state,
    "as it may be useful for debugging" (``explorer.rs:199-232``); unknown
    fingerprints give 404 (``explorer.rs:233-237``).
+ - ``GET /.metrics`` — live flight-recorder telemetry (beyond the
+   reference): ``{summary, series, occupancy, counters}`` for runs spawned
+   with ``.telemetry()`` (``stateright_tpu/telemetry/``); 404 otherwise.
+   The UI draws throughput/occupancy sparklines from it.
  - ``GET /`` — the bundled single-page UI (``ui/``; ours, not the
    reference's).
 
@@ -144,6 +148,37 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
     }
 
 
+def _metrics_view(checker) -> Optional[dict]:
+    """``GET /.metrics``: the run's flight-recorder telemetry
+    (``stateright_tpu/telemetry/``) — summary + the recent per-step series
+    the UI sparklines draw.  None (-> 404) when the run was spawned without
+    ``.telemetry()``: the endpoint never fabricates numbers."""
+    rec = getattr(checker, "flight_recorder", None)
+    if rec is None:
+        return None
+    steps = rec.records("step")[-120:]
+    series: dict = {
+        "t": [], "states_per_sec": [], "unique": [], "load_factor": [],
+        "dedup": [],
+    }
+    for r in steps:
+        series["t"].append(r["t"])
+        dt = r.get("dt") or 0.0
+        series["states_per_sec"].append(
+            round(r.get("d_states", 0) / dt, 1) if dt > 0 else None
+        )
+        series["unique"].append(r.get("unique"))
+        series["load_factor"].append(r.get("load_factor"))
+        series["dedup"].append(r.get("dedup"))
+    occ = rec.records("occupancy")
+    return {
+        "summary": rec.summary(),
+        "series": series,
+        "occupancy": occ[-1] if occ else None,
+        "counters": rec.counters(),
+    }
+
+
 def _pretty(state) -> str:
     return _indent_repr(repr(state))
 
@@ -234,6 +269,19 @@ def _make_handler(model, checker, snapshot: _Snapshot):
             path = self.path.split("?", 1)[0]
             if path == "/.status":
                 self._send_json(_status_view(model, checker, snapshot))
+                return
+            if path == "/.metrics":
+                view = _metrics_view(checker)
+                if view is None:
+                    self._send_json(
+                        {
+                            "error": "telemetry not enabled for this run "
+                            "(spawn with .telemetry())"
+                        },
+                        404,
+                    )
+                    return
+                self._send_json(view)
                 return
             if path == "/.states" or path.startswith("/.states/"):
                 raw = path[len("/.states") :].strip("/")

@@ -3,13 +3,19 @@ e.g. ``examples/paxos.rs:314-395``): subcommands ``check [args]``,
 ``check-sym``, ``explore [addr]``, ``spawn``, with positional arguments.
 Beyond the reference's verbs: ``check-tpu`` / ``check-sym-tpu`` (device
 engines), ``check-auto`` (measured engine selection,
-``CheckerBuilder.spawn_auto``), and ``audit`` (the static preflight
-auditor, ``stateright_tpu/analysis/``).
+``CheckerBuilder.spawn_auto``), ``audit`` (the static preflight auditor,
+``stateright_tpu/analysis/``), and ``profile`` (a telemetry-instrumented
+run: flight-recorder JSONL + optional Chrome trace,
+``stateright_tpu/telemetry/``, ``docs/telemetry.md``).
 
 Fleet mode — ``python -m stateright_tpu.models._cli audit [MODULE...]`` —
 audits every shipped example (each module exposes ``_audit_models()``),
 printing one report per configuration and exiting non-zero on any
-error-severity finding; CI gates on it.
+error-severity finding; CI gates on it.  ``python -m
+stateright_tpu.models._cli profile [MODULE] [--out=F] [--chrome=F]
+[ARGS...]`` profiles one example's configurations through the same
+``_audit_models`` hook (CI runs it as a smoke and uploads the JSONL as a
+workflow artifact).
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ def run_cli(
     explore: Optional[Callable[[list], None]] = None,
     spawn: Optional[Callable[[list], None]] = None,
     audit: Optional[Callable[[list], None]] = None,
+    profile: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -50,12 +57,17 @@ def run_cli(
         spawn(rest)
     elif cmd == "audit" and audit is not None:
         audit(rest)
+    elif cmd == "profile" and profile is not None:
+        profile(rest)
     else:
         print("USAGE:")
         print(usage)
         if audit is not None:
             print("  <example> audit    # static preflight audit "
                   "(docs/analysis.md)")
+        if profile is not None:
+            print("  <example> profile [--out=F] [--chrome=F] [ARGS]  "
+                  "# telemetry run (docs/telemetry.md)")
 
 
 def default_threads() -> int:
@@ -93,6 +105,113 @@ def make_audit_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
     return _audit
 
 
+# -- profile verb ------------------------------------------------------------
+
+
+def _split_profile_args(args: list) -> tuple:
+    """``(--out, --chrome, rest)`` from a profile verb's argument list."""
+    out, chrome, rest = "telemetry.jsonl", None, []
+    for a in args:
+        if a.startswith("--out="):
+            out = a[len("--out="):]
+        elif a.startswith("--chrome="):
+            chrome = a[len("--chrome="):]
+        else:
+            rest.append(a)
+    return out, chrome, rest
+
+
+def profile_models(
+    models: Iterable[tuple], out: str, chrome: Optional[str] = None,
+    stream=None,
+) -> dict:
+    """Run each ``(label, model)`` with the flight recorder enabled and
+    append one JSONL export per run to ``out`` (Chrome trace of the LAST
+    run to ``chrome`` if given).  The engine is the device wavefront (CPU
+    backend off-hardware — same code path); models without a tensor twin
+    fall back to host BFS so the verb works on every example.  Prints one
+    summary line per run; returns the last summary."""
+    import json
+
+    from ..parallel.actor_compiler import CompileError
+
+    stream = stream or sys.stdout
+    summary: dict = {}
+    first = True
+    for label, model in models:
+        builder = model.checker().telemetry(occupancy_every=4)
+        # detect "no device form" EXPLICITLY (the spawn_auto twin probe)
+        # instead of catching exception types from inside spawn_tpu:
+        # genuine device-run failures (poison rows, growth bugs, wiring
+        # TypeErrors) must PROPAGATE so the CI profile smoke fails on a
+        # broken engine rather than quietly uploading host telemetry.
+        twin_err = None
+        try:
+            cached = getattr(model, "_tensor_cached", None)
+            twin = (
+                cached()
+                if cached is not None
+                else getattr(model, "tensor_model", lambda: None)()
+            )
+        except CompileError as e:
+            twin, twin_err = None, e
+        if twin is None:
+            why = type(twin_err).__name__ if twin_err else "no tensor twin"
+            print(
+                f"--- {label}: device engine unavailable ({why}); "
+                "profiling host BFS", file=stream,
+            )
+            checker = builder.spawn_bfs().join()
+        else:
+            checker = builder.spawn_tpu(sync=True)
+        rec = checker.flight_recorder
+        rec.update_meta(label=label)
+        rec.to_jsonl(out, append=not first)
+        first = False
+        if chrome:
+            rec.to_chrome_trace(chrome)
+        summary = rec.summary()
+        print(f"--- {label}", file=stream)
+        print(json.dumps(summary, default=str), file=stream)
+    return summary
+
+
+def make_profile_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as a ``profile``
+    CLI verb (``--out=``/``--chrome=`` flags, remaining args to the
+    factory)."""
+
+    def _profile(rest: list) -> None:
+        out, chrome, rest = _split_profile_args(rest)
+        profile_models(factory(rest), out, chrome=chrome)
+        print(f"telemetry JSONL written to {out}"
+              + (f", Chrome trace to {chrome}" if chrome else ""))
+
+    return _profile
+
+
+def fleet_profile(args: Optional[list] = None, stream=None) -> int:
+    """``profile [MODULE] [--out=F] [--chrome=F] [ARGS...]``: profile one
+    example module's ``_audit_models`` configurations; 0 on success."""
+    import importlib
+
+    stream = stream or sys.stdout
+    out, chrome, rest = _split_profile_args(list(args or []))
+    name = rest.pop(0) if rest else "two_phase_commit"
+    try:
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+    except ImportError as e:
+        print(f"profile: cannot import models.{name}: {e}", file=stream)
+        return 1
+    factory = getattr(mod, "_audit_models", None)
+    if factory is None:
+        print(f"{name}: no _audit_models hook to profile", file=stream)
+        return 1
+    profile_models(factory(rest), out, chrome=chrome, stream=stream)
+    print(f"telemetry JSONL written to {out}", file=stream)
+    return 0
+
+
 def fleet_audit(names: Optional[list] = None, stream=None) -> int:
     """Audit the whole example fleet (or just ``names``); 0 iff clean.
     Modules without an ``_audit_models`` hook are reported and skipped."""
@@ -125,10 +244,16 @@ def main(argv: Optional[list] = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "audit":
         raise SystemExit(fleet_audit(argv[1:]))
+    if argv and argv[0] == "profile":
+        raise SystemExit(fleet_profile(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
           "(docs/analysis.md)")
+    print("  python -m stateright_tpu.models._cli profile [MODULE] "
+          "[--out=F] [--chrome=F] [ARGS...]")
+    print("    telemetry-instrumented run; flight-recorder JSONL export "
+          "(docs/telemetry.md)")
 
 
 if __name__ == "__main__":

@@ -36,7 +36,7 @@ from ..actor.register import (
 )
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import default_threads, make_audit_cmd, make_profile_cmd, run_cli
 
 def _ballot_zero() -> tuple:
     return (0, Id(0))
@@ -360,6 +360,7 @@ def main(argv=None):
         explore=explore,
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
         argv=argv,
     )
 

@@ -24,7 +24,7 @@ from typing import Optional
 from .. import Model, Property
 from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
 from ..symmetry import RewritePlan
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import default_threads, make_audit_cmd, make_profile_cmd, run_cli
 
 # RM states, ordered so sorting gives a canonical symmetry representative
 WORKING = "working"
@@ -429,6 +429,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         audit=make_audit_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
         argv=argv,
     )
 

@@ -88,6 +88,31 @@ class WavefrontChecker(Checker):
         self._target = options.target_state_count
         self._verify_fingerprint_bridge()
 
+        # flight recorder (stateright_tpu/telemetry/): engines record one
+        # "step" record per host sync from values the loop already pulls —
+        # telemetry never adds device ops (docs/telemetry.md overhead
+        # contract); occupancy sampling / profiling are explicit opt-ins.
+        self._telemetry_opts = options.telemetry_opts or {}
+        tag = "wavefront" if self._engine_tag == "single" else self._engine_tag
+        self.flight_recorder = options._make_recorder(tag)
+        self._profiler = None
+        if (
+            self.flight_recorder is not None
+            and self._telemetry_opts.get("profile_steps")
+        ):
+            import tempfile
+
+            from ..telemetry import ScopedProfiler
+
+            logdir = self._telemetry_opts.get("profile_dir") or (
+                tempfile.mkdtemp(prefix="stateright-tpu-profile-")
+            )
+            self._profiler = ScopedProfiler(
+                logdir,
+                int(self._telemetry_opts["profile_steps"]),
+                self.flight_recorder,
+            )
+
         self._results = None
         self._parent_map: Optional[dict[int, int]] = None
         self._done = threading.Event()
@@ -155,6 +180,24 @@ class WavefrontChecker(Checker):
                 "resume snapshot was taken from a different model "
                 "(init fingerprints / tensor signature disagree)"
             )
+
+    def _telemetry_occupancy(self, table_fp, *, at: str,
+                             transferred: bool = False) -> None:
+        """Record one visited-table occupancy sample (time-series element
+        of ``ops/buckets.occupancy_stats``).  ``transferred=True`` prices
+        the D2H table pull into the recorder's byte counters; growth
+        boundaries pass False — the table is host-side there anyway."""
+        rec = self.flight_recorder
+        if rec is None:
+            return
+        import numpy as _np
+
+        from ..ops.buckets import occupancy_stats
+
+        arr = _np.asarray(table_fp)
+        if transferred:
+            rec.add_bytes(d2h=arr.nbytes)
+        rec.record("occupancy", at=at, **occupancy_stats(arr))
 
     # -- stop/checkpoint protocol (engines define _final_snapshot and serve
     # _ckpt_req at their host sync points) -----------------------------------

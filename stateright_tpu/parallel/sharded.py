@@ -719,6 +719,22 @@ class ShardedTpuChecker(WavefrontChecker):
             self.tensor._sharded_run_cache = cache
         mesh_key = tuple(d.id for d in self.mesh.devices.flat)
 
+        rec = self.flight_recorder
+        occ_every = int(self._telemetry_opts.get("occupancy_every") or 0)
+        syncs = 0
+        if rec is not None:
+            rec.update_meta(
+                devices=self.ndev, steps_per_call=self._steps,
+            )
+        # sharded status words, named for growth records — keyed on THIS
+        # engine's codes (they are numbered differently from wavefront's;
+        # the names come from the telemetry.STATUS_NAMES vocabulary)
+        status_names = {
+            _OK: "ok", _FRONTIER_OVERFLOW: "frontier_full",
+            _TABLE_OVERFLOW: "table_full", _BUCKET_OVERFLOW: "bucket_full",
+            _CAND_OVERFLOW: "cand_full", _POISON: "poison",
+        }
+
         pending = None  # host carry to feed step_fn (resume or post-growth)
         finished = None  # carry of an already-complete resume snapshot
         if self._resume is not None:
@@ -743,6 +759,21 @@ class ShardedTpuChecker(WavefrontChecker):
             key = (mesh_key, cap, fcap, bucket_cap, cand_local, self._target,
                    sym, self._steps)
             fns = cache.get(key)
+            if rec is not None and key != getattr(
+                self, "_last_engine_key", None
+            ):
+                # engine-cache accounting, as in wavefront.py: counted only
+                # when the engine is (re)acquired (init + growth rebuilds)
+                rec.add(
+                    "compile_cache_hits" if fns is not None
+                    else "compile_cache_misses"
+                )
+                if fns is None:
+                    rec.record(
+                        "compile", cap=cap * self.ndev, fcap=fcap,
+                        bucket_cap=bucket_cap, cand=cand_local,
+                    )
+            self._last_engine_key = key
             if fns is None:
                 fns = _build_sharded_run(
                     self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
@@ -774,6 +805,23 @@ class ShardedTpuChecker(WavefrontChecker):
                 )
                 self._live = (scount, unique, depth)
                 self._live_disc = np.asarray(disc)
+                if rec is not None:
+                    syncs += 1
+                    # the replicated scalars + discovery vector are the
+                    # per-sync D2H transfer (lockstep-growth round-trips
+                    # are recorded as events, not byte-priced)
+                    rec.add_bytes(d2h=5 * 8 + np.asarray(disc).nbytes)
+                    rec.step(
+                        engine="sharded", states=scount, unique=unique,
+                        depth=depth, status=status,
+                        cap=cap * self.ndev, cand=cand_local * self.ndev,
+                        load_factor=round(unique / (cap * self.ndev), 6),
+                    )
+                    if occ_every and syncs % occ_every == 0:
+                        self._telemetry_occupancy(
+                            self._host_table(carry[0]),
+                            at=f"sync{syncs}", transferred=True,
+                        )
                 if self._ckpt_req is not None and self._ckpt_req.is_set():
                     self._ckpt_out = self._carry_to_snapshot(
                         carry, more, cap, fcap, bf, cf
@@ -782,8 +830,12 @@ class ShardedTpuChecker(WavefrontChecker):
                     self._ckpt_ready.set()
                 if status != _OK or not more or self._stop.is_set():
                     break
+                if self._profiler is not None:
+                    self._profiler.maybe_start()
                 out = step_fn(*carry)
                 from_init = False
+                if self._profiler is not None:
+                    self._profiler.tick()
             if status == _POISON:
                 raise RuntimeError(
                     "poisoned rows reached by the device run: a compiled "
@@ -793,6 +845,14 @@ class ShardedTpuChecker(WavefrontChecker):
                     "configuration actually reaches)."
                 )
             if status != _OK and not self._stop.is_set():
+                if rec is not None:
+                    rec.record(
+                        "growth", status=status_names.get(status, str(status)),
+                        unique=unique, cap=cap * self.ndev,
+                        from_init=from_init,
+                    )
+                    if status == _CAND_OVERFLOW:
+                        rec.add("compaction_hits")
                 if from_init:
                     # init overflow: nothing ran yet, so a plain re-init at
                     # doubled capacity loses no work (device_init is not
@@ -820,6 +880,8 @@ class ShardedTpuChecker(WavefrontChecker):
             break
         self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf
         self._cand_factor = cf
+        if self._profiler is not None:
+            self._profiler.stop()
         self._results = {
             "unique": unique,
             "states": scount,
@@ -828,6 +890,17 @@ class ShardedTpuChecker(WavefrontChecker):
             "table_fp": self._host_table(carry[0]),
             "table_parent": self._host_table(carry[1]),
         }
+        if rec is not None:
+            # the final tables just crossed to the host for _results —
+            # price that pull, then take the closing occupancy sample on
+            # the already-host-side array (free)
+            rec.add_bytes(
+                d2h=self._results["table_fp"].nbytes
+                + self._results["table_parent"].nbytes
+            )
+            self._telemetry_occupancy(
+                self._results["table_fp"], at="final", transferred=False
+            )
         # keep the final carry device-resident; a stopped run's snapshot
         # keeps more=1 so resume continues it (see _final_snapshot)
         self._final_state = (carry, more, (cap, fcap, bf, cf))

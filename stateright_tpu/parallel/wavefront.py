@@ -77,6 +77,17 @@ _STATUS_TABLE_FULL = 2
 _STATUS_CAND_FULL = 3  # valid candidates exceeded the compaction budget
 _STATUS_POISON = 4  # a compiled-twin transition crossed its compile bound
 
+# growth-record names for the flight recorder, keyed on THIS engine's
+# status words (telemetry.STATUS_NAMES is the cross-engine vocabulary;
+# the sharded engine numbers its codes differently and keeps its own map)
+_STATUS_TELEMETRY_NAMES = {
+    _STATUS_OK: "ok",
+    _STATUS_QUEUE_FULL: "queue_full",
+    _STATUS_TABLE_FULL: "table_full",
+    _STATUS_CAND_FULL: "cand_full",
+    _STATUS_POISON: "poison",
+}
+
 # Carry tuple indices (shared by the jitted program and the host loop).
 # No occupancy-counts buffer exists: bucket occupancy is implicit in the
 # table (slots fill densely; see ops/buckets.py).
@@ -429,6 +440,23 @@ class TpuChecker(WavefrontChecker):
         key = (cap, qcap, batch, cand, self._steps, self._target,
                self._pallas, sym)
         eng = cache.get(key)
+        if (
+            self.flight_recorder is not None
+            and key != getattr(self, "_last_engine_key", None)
+        ):
+            # compiled-run cache accounting: a miss means a fresh trace +
+            # XLA compile is about to be paid (growth events recompile).
+            # Only counted when the engine is (re)ACQUIRED — the run loop
+            # re-fetches run_fn every sync, which must not inflate hits.
+            self.flight_recorder.add(
+                "compile_cache_hits" if eng is not None
+                else "compile_cache_misses"
+            )
+            if eng is None:
+                self.flight_recorder.record(
+                    "compile", cap=cap, qcap=qcap, batch=batch, cand=cand,
+                )
+        self._last_engine_key = key
         if eng is None:
             eng = _build_engine(
                 self.tensor, self._props, cap, qcap, batch, self._steps,
@@ -559,6 +587,13 @@ class TpuChecker(WavefrontChecker):
                 if cap == prev:
                     cap *= 2  # guarantee progress on a clustered init set
 
+        rec = self.flight_recorder
+        occ_every = int(self._telemetry_opts.get("occupancy_every") or 0)
+        syncs = 0
+        if rec is not None:
+            rec.update_meta(
+                batch=batch, steps_per_call=self._steps, pallas=self._pallas,
+            )
         while True:
             # one host sync per iteration: the packed stats vector
             if stats is None:
@@ -572,6 +607,21 @@ class TpuChecker(WavefrontChecker):
             with self._live_lock:
                 self._live = (scount, unique, maxdepth)
                 self._live_disc = np.asarray(disc)
+            if rec is not None:
+                # all fields below are host state the loop already synced —
+                # the telemetry cost is one dict append per block
+                syncs += 1
+                rec.add_bytes(d2h=stats.nbytes)
+                rec.step(
+                    engine="wavefront", states=scount, unique=unique,
+                    depth=maxdepth, status=status,
+                    queue=max(tail - head, 0), cap=cap, cand=cand,
+                    load_factor=round(unique / cap, 6),
+                )
+                if occ_every and syncs % occ_every == 0:
+                    self._telemetry_occupancy(
+                        carry[_TFP], at=f"sync{syncs}", transferred=True
+                    )
             # serve a pending checkpoint BEFORE growing: a request landing on
             # a growth boundary snapshots the boundary carry (status != OK),
             # and resume re-applies the growth (the flag travels with the
@@ -590,6 +640,16 @@ class TpuChecker(WavefrontChecker):
                 )
             if status != _STATUS_OK:
                 self.growth_events.append((status, unique))
+                if rec is not None:
+                    rec.record(
+                        "growth",
+                        status=_STATUS_TELEMETRY_NAMES.get(
+                            status, str(status)
+                        ),
+                        unique=unique, cap=cap, qcap=qcap, cand=cand,
+                    )
+                    if status == _STATUS_CAND_FULL:
+                        rec.add("compaction_hits")
                 if status == _STATUS_CAND_FULL:
                     # the candidate budget is an engine parameter, not a
                     # carry buffer: double it, clear the carry's status word
@@ -606,9 +666,22 @@ class TpuChecker(WavefrontChecker):
                     stats = None
                     continue
                 carry_np = [np.asarray(c) for c in carry]
+                if rec is not None:
+                    # the whole carry just crossed to the host (and goes
+                    # back after growth) — price it, and take the free
+                    # occupancy sample growth boundaries offer
+                    nbytes = sum(a.nbytes for a in carry_np if a.ndim)
+                    rec.add_bytes(d2h=nbytes)
+                    self._telemetry_occupancy(
+                        carry_np[_TFP], at="growth", transferred=False
+                    )
                 cap, qcap, carry_np = self._grow(
                     carry_np, cap, qcap, batch, arity, status, cand
                 )
+                if rec is not None:
+                    rec.add_bytes(
+                        h2d=sum(a.nbytes for a in carry_np if a.ndim)
+                    )
                 carry = [jnp.asarray(c) for c in carry_np]
                 stats = None
                 continue
@@ -622,11 +695,22 @@ class TpuChecker(WavefrontChecker):
             if done:
                 break
             _, run_fn = self._engine(cap, qcap, batch, cand)
+            if self._profiler is not None:
+                self._profiler.maybe_start()
             carry, stats = run_fn(tuple(carry))
             carry = list(carry)
             stats = np.asarray(stats)
+            if self._profiler is not None:
+                self._profiler.tick()
 
         self._cap, self._qcap, self._cand = cap, qcap, cand
+        if self._profiler is not None:
+            self._profiler.stop()
+        if rec is not None and occ_every:
+            # close the occupancy time series with the final table (an
+            # explicit D2H pull, taken only when sampling was requested)
+            self._telemetry_occupancy(carry[_TFP], at="final",
+                                      transferred=True)
         # Keep final buffers on device; pulling the table/queue through the
         # tunnel costs far more than the run's last batches, so snapshots and
         # parent maps materialize lazily on demand.

@@ -1,0 +1,38 @@
+"""Flight recorder: streaming run telemetry for every checker strategy.
+
+The engines were flying blind: the bench headline is a single states/sec
+number, ``occupancy_stats`` is a point-in-time probe, and nothing records
+*how* a run unfolded — per-step frontier dynamics, dedup ratios, table
+occupancy drift, growth/compaction events, transfer volume.  GPUexplore's
+scalability study (PAPERS.md) shows hash-table occupancy and per-iteration
+frontier dynamics are exactly the signals that explain accelerator
+model-checker throughput; this package is the instrumentation layer every
+perf claim is measured with.
+
+Pieces:
+
+ - :class:`FlightRecorder` (``recorder.py``) — a bounded ring buffer of
+   structured records plus monotone aggregate counters.  Engines append one
+   ``step`` record per host sync (device engines: one per
+   ``steps_per_call`` block; host engines: one per job block / mp round),
+   plus ``growth`` / ``occupancy`` / ``compile`` / ``profile`` events.
+ - JSONL + Chrome-trace export (``export.py``) — ``to_jsonl`` /
+   ``from_jsonl`` round-trip, and ``to_chrome_trace`` for chrome://tracing
+   / Perfetto.
+ - :class:`ScopedProfiler` (``profile.py``) — a scoped ``jax.profiler``
+   hook that traces the first N hot steps of a device run to a logdir.
+
+Enabled per run via ``model.checker().telemetry()``; the recorder then
+hangs off the checker as ``checker.flight_recorder``.  **Overhead
+contract**: telemetry reads only host-visible state the engines already
+sync (the packed stats vector), so disabling it adds zero ops to the step
+jaxpr and enabling it costs <3% wall time (asserted in
+``tests/test_telemetry.py``).  Optional occupancy sampling
+(``occupancy_every=N``) pulls the visited table and is priced separately
+(recorded as D2H bytes).
+"""
+
+from .recorder import FlightRecorder, STATUS_NAMES
+from .profile import ScopedProfiler
+
+__all__ = ["FlightRecorder", "ScopedProfiler", "STATUS_NAMES"]

@@ -1,0 +1,162 @@
+"""Flight-recorder export: JSONL (lossless round-trip) and Chrome trace.
+
+JSONL layout: line 1 is a header object ``{"kind": "header", "meta": {...},
+"summary": {...}, "capacity": N}``; every following line is one record in
+ring order.  ``from_jsonl`` rebuilds a recorder whose ring, meta and
+derived summary match the exported one (aggregate counters are restored
+from the header's summary scalars), pinned by the round-trip test.
+
+Chrome-trace layout (`chrome://tracing` / Perfetto "JSON object format"):
+``step`` records become complete events (``ph: "X"``) whose duration is the
+step's ``dt``; point events (growth, occupancy, compile) become instant
+events (``ph: "i"``); aggregate counters ride a final metadata event.
+Timestamps are microseconds, as the format requires.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .recorder import FlightRecorder
+
+
+def to_jsonl(rec: FlightRecorder, path, append: bool = False) -> None:
+    header = {
+        "kind": "header",
+        "meta": rec.meta_snapshot(),
+        "capacity": rec.capacity,
+        "summary": rec.summary(),
+        "counters": rec.counters(),
+    }
+    with open(path, "a" if append else "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for r in rec.records():
+            f.write(json.dumps(r) + "\n")
+
+
+def from_jsonl(path) -> FlightRecorder:
+    """Rebuild a recorder from a JSONL export (ring + counters + meta).
+    Single-run files round-trip the derived summary exactly even when the
+    ring evicted records: totals the replayed window cannot reconstruct
+    (seq, step/growth counts, cumulative states/unique, wall time) are
+    reconciled from the header's summary.  Multi-run files
+    (``append=True``) fold every run's records into one recorder, later
+    headers overriding meta — their summaries are window-approximate by
+    design."""
+    rec = None
+    headers = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if obj.get("kind") == "header":
+                headers.append(obj)
+                if rec is None:
+                    rec = FlightRecorder(
+                        capacity=int(obj.get("capacity", 4096)),
+                        meta=obj.get("meta") or {},
+                    )
+                else:
+                    rec.update_meta(**(obj.get("meta") or {}))
+                    # run boundary in an appended file: the next run's
+                    # cumulative counters restart from zero — reset the
+                    # delta baseline so they are not clamped/diffed
+                    # against the previous run's totals
+                    rec._reset_step_baseline()
+                for k, v in (obj.get("counters") or {}).items():
+                    rec.add(k, v)
+                continue
+            if rec is None:  # record lines before any header: tolerate
+                rec = FlightRecorder()
+            kind = obj.get("kind", "note")
+            fields = {
+                k: v for k, v in obj.items() if k not in ("seq", "t", "kind")
+            }
+            if kind == "step":
+                stored = rec.step(t=obj.get("t"), **fields)
+            else:
+                stored = rec.record(kind, t=obj.get("t"), **fields)
+            if "seq" in obj:
+                # keep the original sequence numbers (replay renumbers
+                # from 1, which would mislabel a ring that had evicted)
+                stored["seq"] = obj["seq"]
+    if rec is None:
+        return FlightRecorder()
+    if len(headers) == 1:
+        rec._reconcile_totals(headers[0].get("summary") or {})
+    return rec
+
+
+def to_chrome_trace(rec: FlightRecorder, path) -> None:
+    events = []
+    pid = 1
+    for r in rec.records():
+        ts_us = r["t"] * 1e6
+        args = {
+            k: v for k, v in r.items() if k not in ("seq", "t", "kind")
+        }
+        if r["kind"] == "step":
+            dur_us = max(float(r.get("dt", 0.0)) * 1e6, 1.0)
+            events.append({
+                "name": f"step:{r.get('engine', '?')}",
+                "cat": "step",
+                "ph": "X",
+                # complete events anchor at their START time (clamped:
+                # a first step with dt=0 gets dur 1us, which must not
+                # push ts below the trace origin)
+                "ts": round(max(ts_us - dur_us, 0.0), 3),
+                "dur": round(dur_us, 3),
+                "pid": pid,
+                "tid": 1,
+                "args": args,
+            })
+            # counter track: throughput + table load, plotted by the viewer
+            counters = {}
+            if r.get("dt", 0) and r.get("d_states") is not None:
+                counters["states_per_sec"] = round(
+                    r["d_states"] / r["dt"], 1
+                )
+            if r.get("load_factor") is not None:
+                counters["load_factor"] = r["load_factor"]
+            if counters:
+                events.append({
+                    "name": "throughput",
+                    "cat": "step",
+                    "ph": "C",
+                    "ts": round(ts_us, 3),
+                    "pid": pid,
+                    "args": counters,
+                })
+        else:
+            events.append({
+                "name": r["kind"],
+                "cat": r["kind"],
+                "ph": "i",
+                "s": "p",
+                "ts": round(ts_us, 3),
+                "pid": pid,
+                "tid": 1,
+                "args": args,
+            })
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"meta": rec.meta_snapshot(), "summary": rec.summary()},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def from_chrome_trace(path) -> dict:
+    """Parse a Chrome-trace export back into ``{events, meta, summary}`` —
+    the round-trip half used by tests (the trace format is lossy by design:
+    ``seq`` is dropped, step starts are shifted by ``dt``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    return {
+        "events": doc.get("traceEvents", []),
+        "meta": doc.get("otherData", {}).get("meta", {}),
+        "summary": doc.get("otherData", {}).get("summary", {}),
+    }

@@ -1,0 +1,89 @@
+"""Scoped ``jax.profiler`` hook: trace the first N hot steps on device.
+
+A full-run ``jax.profiler`` trace of a long check is unusable (gigabytes,
+and the interesting steady state is identical step after step), so the
+engines instead arm a :class:`ScopedProfiler` that starts the device trace
+at the first engine call and stops it after ``steps`` host syncs — N
+representative hot blocks, bounded output.
+
+Failure policy: profiling must never break a run.  A missing/broken
+profiler backend (jax built without it, an unwritable logdir) downgrades to
+a recorded ``profile`` event with ``error`` set; the check proceeds.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .recorder import FlightRecorder
+
+
+class ScopedProfiler:
+    """Traces the first ``steps`` host-sync blocks to ``logdir``.
+
+    Engines call :meth:`maybe_start` right before their first device call
+    and :meth:`tick` once per host sync; the profiler stops itself after
+    ``steps`` ticks (and is closed defensively by :meth:`stop` at run end
+    either way).  Events land in the flight recorder.
+    """
+
+    def __init__(self, logdir: str, steps: int,
+                 recorder: Optional[FlightRecorder] = None):
+        self.logdir = str(logdir)
+        self.steps = int(steps)
+        self.recorder = recorder
+        self._ticks = 0
+        self._active = False
+        self._failed = False
+
+    def maybe_start(self) -> None:
+        if self._active or self._failed or self.steps <= 0:
+            return
+        try:
+            import jax
+
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            if self.recorder is not None:
+                self.recorder.record(
+                    "profile", event="start", logdir=self.logdir,
+                    steps=self.steps,
+                )
+        except Exception as e:  # noqa: BLE001 - profiling never breaks a run
+            self._failed = True
+            if self.recorder is not None:
+                self.recorder.record(
+                    "profile", event="unavailable",
+                    error=f"{type(e).__name__}: {e}",
+                )
+
+    def tick(self) -> None:
+        """One host sync passed; stop the trace once N were profiled."""
+        if not self._active:
+            return
+        self._ticks += 1
+        if self._ticks >= self.steps:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        self._active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            if self.recorder is not None:
+                self.recorder.record(
+                    "profile", event="stop", logdir=self.logdir,
+                    profiled_steps=self._ticks,
+                )
+        except Exception as e:  # noqa: BLE001
+            self._failed = True
+            if self.recorder is not None:
+                self.recorder.record(
+                    "profile", event="stop-failed",
+                    error=f"{type(e).__name__}: {e}",
+                )

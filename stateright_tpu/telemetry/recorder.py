@@ -1,0 +1,281 @@
+"""The flight recorder: bounded ring of structured records + aggregates.
+
+Record schema (every record):
+
+ - ``seq``  — monotone sequence number (never reset; ``seq - len(records)``
+   is how many old records the ring evicted)
+ - ``t``    — seconds since the recorder was created (monotonic clock)
+ - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
+   | ``"profile"`` | ``"note"``
+
+``step`` records additionally carry the engine tag and cumulative counters
+(``states``, ``unique``) plus derived per-step deltas (``d_states``,
+``d_unique``, ``dedup``, ``dt``) computed against the previous step record
+— so each record is self-contained for streaming consumers (the Explorer's
+``/.metrics`` sparkline reads them directly).
+
+Aggregate counters (transfer bytes, compile-cache hits, growth/compaction
+events) live OUTSIDE the ring so eviction never loses totals; they fold
+into :meth:`FlightRecorder.summary`.
+
+Thread safety: engines record from their run thread while the Explorer
+polls from HTTP handler threads — every mutation and snapshot takes the
+internal lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# Growth-record status vocabulary across engines.  Each engine maps its
+# own numeric status words onto these names NEXT TO its constant
+# definitions (``parallel/wavefront.py`` and ``parallel/sharded.py`` number
+# their codes differently; the integers are never shared, only the names).
+STATUS_NAMES = frozenset({
+    "ok", "queue_full", "table_full", "cand_full", "poison",
+    "frontier_full", "bucket_full",
+})
+
+
+class FlightRecorder:
+    """Bounded, thread-safe run-telemetry recorder.
+
+    ``capacity`` bounds the ring buffer (oldest records evicted); aggregate
+    counters are unbounded scalars.  ``meta`` is carried verbatim into
+    :meth:`summary` and the JSONL header (engine tag, model name, run
+    configuration).
+    """
+
+    def __init__(self, capacity: int = 4096, meta: Optional[dict] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.meta = dict(meta or {})
+        self._records: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._seq = 0
+        self._counters: dict[str, float] = {}
+        # per-kind totals survive ring eviction (the ring is a window, the
+        # counts are the truth)
+        self._kind_counts: dict[str, int] = {}
+        # last step snapshot for delta derivation: (t, states, unique)
+        self._last_step: Optional[tuple] = None
+        # wall-clock origin for summary(): recorder creation (t=0), so
+        # work done before the FIRST step record (init + first compiled
+        # block) is not silently excluded from the throughput denominator.
+        # JSONL replay shifts it to reproduce the exported wall time.
+        self._t_offset = 0.0
+
+    # -- recording -----------------------------------------------------------
+
+    def record(self, kind: str, *, t: Optional[float] = None, **fields) -> dict:
+        """Append one record; returns it (the stored dict)."""
+        with self._lock:
+            self._seq += 1
+            self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
+            rec = {
+                "seq": self._seq,
+                "t": round(self._now() if t is None else t, 6),
+                "kind": kind,
+                **fields,
+            }
+            self._records.append(rec)
+            return rec
+
+    def step(self, *, engine: str, states: int, unique: int,
+             t: Optional[float] = None, **fields) -> dict:
+        """One per-step (per host-sync / per-batch) record.  ``states`` and
+        ``unique`` are CUMULATIVE run counters; deltas and the dedup ratio
+        (fraction of generated states that were already visited) are
+        derived here against the previous step record."""
+        with self._lock:
+            # rounded BEFORE use so a JSONL round-trip (which stores the
+            # rounded value) reproduces the summary bit-for-bit
+            now = round(self._now() if t is None else t, 6)
+            if self._last_step is None:
+                prev_t, prev_states, prev_unique = now, 0, 0
+            else:
+                prev_t, prev_states, prev_unique = self._last_step
+            # cumulative counters are monotone by meaning, but concurrent
+            # pool workers read-then-record without a common lock, so a
+            # late writer can arrive with a stale (smaller) snapshot —
+            # clamp so deltas stay >= 0 and the final summary never
+            # under-reports
+            states = max(int(states), prev_states)
+            unique = max(int(unique), prev_unique)
+            d_states = states - prev_states
+            d_unique = unique - prev_unique
+            self._last_step = (now, states, unique)
+            self._seq += 1
+            self._kind_counts["step"] = self._kind_counts.get("step", 0) + 1
+            rec = {
+                "seq": self._seq,
+                "t": round(now, 6),
+                "kind": "step",
+                "engine": engine,
+                "dt": round(max(now - prev_t, 0.0), 6),
+                "states": int(states),
+                "unique": int(unique),
+                "d_states": int(d_states),
+                "d_unique": int(d_unique),
+                "dedup": (
+                    round(1.0 - d_unique / d_states, 6) if d_states > 0 else 0.0
+                ),
+                **fields,
+            }
+            self._records.append(rec)
+            return rec
+
+    def add(self, counter: str, n: float = 1) -> None:
+        """Bump an aggregate counter (ring-independent; never evicted)."""
+        with self._lock:
+            self._counters[counter] = self._counters.get(counter, 0) + n
+
+    def add_bytes(self, *, h2d: int = 0, d2h: int = 0) -> None:
+        if h2d:
+            self.add("h2d_bytes", int(h2d))
+        if d2h:
+            self.add("d2h_bytes", int(d2h))
+
+    def update_meta(self, **fields) -> None:
+        """Locked meta mutation (engines annotate run config mid-run while
+        the Explorer may be snapshotting concurrently)."""
+        with self._lock:
+            self.meta.update(fields)
+
+    def meta_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.meta)
+
+    # -- reading -------------------------------------------------------------
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def rel(self, monotonic_t: float) -> float:
+        """Map an absolute ``time.monotonic()`` stamp onto this recorder's
+        clock (used when records are replayed from another process's log,
+        e.g. the mp-BFS per-round history)."""
+        return monotonic_t - self._t0
+
+    def records(self, kind: Optional[str] = None) -> list[dict]:
+        """Snapshot of the ring (oldest first), optionally filtered."""
+        with self._lock:
+            recs = list(self._records)
+        if kind is not None:
+            recs = [r for r in recs if r["kind"] == kind]
+        return recs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    @property
+    def dropped(self) -> int:
+        """Records evicted by the ring bound."""
+        with self._lock:
+            return self._seq - len(self._records)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    def summary(self) -> dict:
+        """Aggregate run summary (JSON-safe scalars + small dicts): totals,
+        throughput, dedup ratio, event counts, transfer volume, and the
+        first/last occupancy samples when any were taken."""
+        with self._lock:
+            recs = list(self._records)
+            counters = dict(self._counters)
+            kind_counts = dict(self._kind_counts)
+            seq = self._seq
+            last_step = self._last_step
+            t_offset = self._t_offset
+            meta = dict(self.meta)
+        occ = [r for r in recs if r["kind"] == "occupancy"]
+        out: dict = {
+            **meta,
+            "records": seq,
+            "ring_len": len(recs),
+            "dropped": seq - len(recs),
+            "steps": kind_counts.get("step", 0),
+        }
+        if last_step is not None:
+            t_last, states, unique = last_step
+            # wall runs from recorder creation (not the first step record):
+            # states found before the first host sync must pay their time
+            wall = max(t_last - t_offset, 0.0)
+            out["states"] = int(states)
+            out["unique"] = int(unique)
+            out["wall_secs"] = round(wall, 6)
+            out["states_per_sec"] = (
+                round(states / wall, 1) if wall > 0 else None
+            )
+            out["dedup_ratio"] = (
+                round(1.0 - unique / states, 6) if states > 0 else 0.0
+            )
+        out["growth_events"] = kind_counts.get("growth", 0)
+        for key in ("h2d_bytes", "d2h_bytes", "compile_cache_hits",
+                    "compile_cache_misses", "compaction_hits"):
+            out[key] = int(counters.get(key, 0))
+        if occ:
+            keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
+                    "poisson_full_expect", "nbuckets")
+            out["occupancy_samples"] = len(occ)
+            out["occupancy_first"] = {
+                k: occ[0].get(k) for k in keep if k in occ[0]
+            }
+            out["occupancy_last"] = {
+                k: occ[-1].get(k) for k in keep if k in occ[-1]
+            }
+        return out
+
+    def _reconcile_totals(self, summary: dict) -> None:
+        """Restore totals the ring window cannot reconstruct from an
+        exported summary (``export.from_jsonl``): sequence/kind counts and
+        the cumulative step snapshot, so a round-trip through a file whose
+        ring had evicted records still reproduces ``summary()``."""
+        with self._lock:
+            self._seq = max(self._seq, int(summary.get("records", 0)))
+            for kind, key in (("step", "steps"),
+                              ("growth", "growth_events")):
+                if key in summary:
+                    self._kind_counts[kind] = max(
+                        self._kind_counts.get(kind, 0), int(summary[key])
+                    )
+            if summary.get("states") is not None and self._last_step:
+                last_t = self._last_step[0]
+                self._last_step = (
+                    last_t, int(summary["states"]), int(summary["unique"])
+                )
+                if summary.get("wall_secs") is not None:
+                    self._t_offset = last_t - float(summary["wall_secs"])
+
+    def _reset_step_baseline(self) -> None:
+        """Start a fresh delta baseline (JSONL replay at a run boundary:
+        the next run's cumulative counters restart from zero and must not
+        be clamped against the previous run's totals)."""
+        with self._lock:
+            self._last_step = None
+
+    # -- export (see export.py) ----------------------------------------------
+
+    def to_jsonl(self, path, append: bool = False) -> None:
+        from .export import to_jsonl
+
+        to_jsonl(self, path, append=append)
+
+    def to_chrome_trace(self, path) -> None:
+        from .export import to_chrome_trace
+
+        to_chrome_trace(self, path)
+
+    @classmethod
+    def from_jsonl(cls, path) -> "FlightRecorder":
+        from .export import from_jsonl
+
+        return from_jsonl(path)

@@ -66,6 +66,77 @@ function renderProperties(props, done) {
   }
 }
 
+// ------------------------------------------------------------- telemetry --
+// Runs spawned with .telemetry() serve /.metrics; otherwise it 404s once
+// and the panel stays hidden (no re-polling a run that can't have it).
+let metricsAvailable = null; // null = unknown, probe on first poll
+
+function sparkline(svg, values, fmt) {
+  svg.innerHTML = "";
+  const pts = values
+    .map((v, i) => [i, v])
+    .filter(([, v]) => v !== null && v !== undefined && isFinite(v));
+  if (pts.length < 2) return null;
+  const xs = pts.map(([i]) => i), ys = pts.map(([, v]) => v);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const hi = Math.max(...ys), lo = Math.min(...ys);
+  const W = 300, H = 40, PAD = 3;
+  const sx = (i) => ((i - x0) / Math.max(x1 - x0, 1)) * (W - 2 * PAD) + PAD;
+  const sy = (v) =>
+    H - PAD - ((v - lo) / Math.max(hi - lo, 1e-12)) * (H - 2 * PAD);
+  const line = document.createElementNS("http://www.w3.org/2000/svg", "polyline");
+  line.setAttribute("points", pts.map(([i, v]) => sx(i) + "," + sy(v)).join(" "));
+  line.setAttribute("class", "spark-line");
+  svg.appendChild(line);
+  const dot = document.createElementNS("http://www.w3.org/2000/svg", "circle");
+  const [li, lv] = pts[pts.length - 1];
+  dot.setAttribute("cx", sx(li));
+  dot.setAttribute("cy", sy(lv));
+  dot.setAttribute("r", 2.2);
+  dot.setAttribute("class", "spark-dot");
+  svg.appendChild(dot);
+  return fmt ? fmt(lv) : lv;
+}
+
+const fmtRate = (v) =>
+  v >= 1e6 ? (v / 1e6).toFixed(1) + "M/s"
+  : v >= 1e3 ? (v / 1e3).toFixed(1) + "k/s"
+  : v.toFixed(0) + "/s";
+
+async function pollMetrics() {
+  if (metricsAvailable === false) return;
+  try {
+    const r = await fetch("/.metrics");
+    if (!r.ok) {
+      metricsAvailable = false;
+      return;
+    }
+    const m = await r.json();
+    metricsAvailable = true;
+    $("telemetry").hidden = false;
+    const last = sparkline($("spark-rate"), m.series.states_per_sec, fmtRate);
+    $("tele-rate").textContent = last === null ? "" : "· " + last;
+    const load = sparkline(
+      $("spark-load"), m.series.load_factor,
+      (v) => (v * 100).toFixed(1) + "%"
+    );
+    $("tele-load").textContent = load === null ? "" : "· " + load;
+    const s = m.summary;
+    const bits = [];
+    if (s.steps !== undefined) bits.push("steps=" + s.steps);
+    if (s.dedup_ratio !== undefined) bits.push("dedup=" + s.dedup_ratio);
+    if (s.growth_events) bits.push("growth=" + s.growth_events);
+    if (m.occupancy)
+      bits.push(
+        "buckets max=" + m.occupancy.max_bucket +
+        " full=" + m.occupancy.full_buckets
+      );
+    $("tele-summary").textContent = bits.join("  ") || "—";
+  } catch (e) {
+    /* transient; retry next poll */
+  }
+}
+
 // ----------------------------------------------------------------- steps --
 let loadSeq = 0; // drop out-of-order responses so fast navigation stays sane
 
@@ -197,5 +268,7 @@ document.addEventListener("keydown", (e) => {
 
 window.addEventListener("hashchange", route);
 pollStatus();
+pollMetrics();
 setInterval(pollStatus, 2000);
+setInterval(pollMetrics, 2000);
 route();

@@ -131,10 +131,12 @@ def test_every_line_is_small(bench_env, capsys):
     assert details["tpu_cfg199_states_per_sec"] == 199.0 * 7
 
 
-def test_dead_tunnel_degrades_to_validated_number(bench_env, capsys):
-    """No fresh TPU number + a stored chip-validated result: the line
-    carries the stale-but-real value with fresh=false + provenance —
-    never value 0 (the 4-rounds-of-parsed=null failure mode)."""
+def test_dead_tunnel_stale_never_headlines(bench_env, capsys):
+    """No fresh TPU number + a stored chip-validated result: the stored
+    number rides ONLY the explicit STALE annotation — value stays 0.0 with
+    fresh=false, so a dead-tunnel round can never masquerade as a
+    measurement (the round-5 silent carry-forward: BENCH_r05.json headlined
+    round 4's 266.7k while the chip never ran)."""
     validated = {
         "tpu_paxos3_states_per_sec": 266699.0,
         "tpu_paxos3_unique": 1_194_428,
@@ -148,15 +150,36 @@ def test_dead_tunnel_degrades_to_validated_number(bench_env, capsys):
     b.emit(cpu_paxos3_states_per_sec=4000.0, cpu_load1=2.5,
            error="TPU phase stuck in backend init for 120s")
     (line,) = _lines(capsys)
-    assert line["value"] == 266699.0
+    assert line["value"] == 0.0
     assert line["fresh"] is False
+    assert line["vs_baseline"] == 0.0
+    # the stale number appears only inside the explicit annotation
+    assert line["stale"].startswith(
+        "STALE (fresh=false, carried from 2026-07-31T03:30:00Z)"
+    )
+    assert "266699.0 states/s" in line["stale"]
     assert line["validated_at"] == "2026-07-31T03:30:00Z"
+    assert line.get("tpu_paxos3_states_per_sec") is None
     assert "error" in line
     # contended same-run CPU (4000 < 80% of stored 8188, load 2.5): the
     # stored uncontended baseline is used and the choice is disclosed
     assert line["cpu_baseline_states_per_sec"] == 8188.4
     assert line["cpu_baseline_src"].startswith("stored-uncontended")
-    assert line["vs_baseline"] == round(266699.0 / 8188.4, 3)
+
+
+def test_fresh_number_clears_stale_annotation(bench_env, capsys):
+    """Once a fresh chip number lands, the headline is real again and the
+    STALE annotation disappears."""
+    with open(os.environ["BENCH_VALIDATED_FILE"], "w") as f:
+        json.dump({"tpu_paxos3_states_per_sec": 266699.0,
+                   "validated_at": "2026-07-31T03:30:00Z"}, f)
+    b = _load_bench()
+    b.emit(cpu_paxos3_states_per_sec=8000.0)
+    b.emit(tpu_paxos3_states_per_sec=320_000.0)
+    first, second = _lines(capsys)
+    assert first["value"] == 0.0 and "STALE" in first["stale"]
+    assert second["value"] == 320_000.0 and second["fresh"] is True
+    assert "stale" not in second
 
 
 def test_idle_same_run_baseline_replaces_stored(bench_env, capsys):

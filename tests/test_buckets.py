@@ -52,7 +52,8 @@ def table_contents(state):
     return dict(zip(tfp[occ].tolist(), tpl[occ].tolist()))
 
 
-@pytest.mark.medium
+# re-tiered fast->slow (PR 2): the fast tier blew the 870s tier-1 budget
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_random_stream_matches_host_set(seed):
     rng = np.random.default_rng(seed)

@@ -19,7 +19,8 @@ def run_full(n, **kw):
     return TwoPhaseSys(n).checker().spawn_tpu(sync=True, **kw)
 
 
-@pytest.mark.medium
+# re-tiered fast->slow (PR 2): the fast tier blew the 870s tier-1 budget
+@pytest.mark.slow
 def test_killed_and_resumed_2pc7_matches_uninterrupted():
     full = run_full(7)
     expected_unique = full.unique_state_count()

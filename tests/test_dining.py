@@ -7,6 +7,8 @@ early-exit applies; and the early-exit semantics itself (the reference's
 all-properties-discovered stop, ``bfs.rs:121-128``) kicks in on both.
 """
 
+import pytest
+
 from stateright_tpu.actor.device_props import forall_actors
 from stateright_tpu.core import Expectation
 from stateright_tpu.models.dining import HAS_LEFT, dining_model
@@ -60,6 +62,8 @@ def test_dining3_device_finds_deadlock():
     assert all(p.phase == HAS_LEFT for p in final.actor_states[:3])
 
 
+# re-tiered fast->slow (PR 2): the fast tier blew the 870s tier-1 budget
+@pytest.mark.slow
 def test_dining4_scales():
     m = _no_early_exit(dining_model(4))
     h = m.checker().spawn_bfs().join()

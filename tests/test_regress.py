@@ -1,0 +1,125 @@
+"""regress.py — the perf-regression gate over bench summaries.
+
+Pins the round-6 contract: a stale artifact (the validated-fallback replay)
+NEVER validates; per-config throughput below tolerance x baseline fails
+loudly with the offending configs named; improvements are reported, not
+punished.
+"""
+
+import importlib.util
+import json
+import os
+
+_REGRESS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "regress.py"
+)
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("regress_under_test",
+                                                  _REGRESS)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+BASELINE = {
+    "tpu_paxos3_states_per_sec": 266699.0,
+    "tpu_2pc7_states_per_sec": 1450000.0,
+    "tpu_2pc4_states_per_sec": 9000.0,
+    "cpu_paxos3_uncontended_states_per_sec": 8188.4,  # not a tpu_ key
+    "validated_at": "2026-07-31T03:30:00Z",
+}
+
+
+def test_compare_clean_fresh_run():
+    r = _load()
+    verdict = r.compare(
+        {"fresh": True,
+         "tpu_paxos3_states_per_sec": 280000.0,
+         "tpu_2pc7_states_per_sec": 1400000.0},
+        BASELINE,
+    )
+    assert verdict["ok"] is True
+    assert verdict["checked"] == 2  # only keys present in BOTH, tpu_ only
+    assert verdict["regressed"] == []
+    assert [e["config"] for e in verdict["improved"]] == [
+        "tpu_paxos3_states_per_sec"
+    ]
+
+
+def test_compare_flags_regression_with_detail():
+    r = _load()
+    verdict = r.compare(
+        {"fresh": True,
+         "tpu_paxos3_states_per_sec": 100000.0,  # 0.37x: regression
+         "tpu_2pc7_states_per_sec": 1300000.0},  # 0.90x: within tolerance
+        BASELINE,
+    )
+    assert verdict["ok"] is False
+    (bad,) = verdict["regressed"]
+    assert bad["config"] == "tpu_paxos3_states_per_sec"
+    assert bad["ratio"] == 0.375
+    assert bad["baseline"] == 266699.0
+
+
+def test_compare_stale_run_is_not_ok():
+    r = _load()
+    verdict = r.compare(
+        {"fresh": False, "tpu_paxos3_states_per_sec": 266699.0}, BASELINE
+    )
+    assert verdict["ok"] is False and verdict["fresh"] is False
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    # fresh + clean -> 0
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0})
+    assert rc == 0 and v["ok"] is True
+    # regression -> 1, offender named on stdout
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 1000.0})
+    assert rc == 1 and v["regressed"][0]["config"] == (
+        "tpu_paxos3_states_per_sec"
+    )
+    # stale -> 2 (the round-5 carry-forward can never validate)
+    rc, v = run({"fresh": False, "value": 0.0,
+                 "stale": "STALE (fresh=false, carried from r04)"})
+    assert rc == 2 and v["fresh"] is False and "STALE" in v["stale"]
+    # --allow-stale compares two stored artifacts without the fresh gate
+    rc, v = run(
+        {"fresh": False, "tpu_paxos3_states_per_sec": 266699.0},
+        "--allow-stale",
+    )
+    assert rc == 0
+
+
+def test_main_unwraps_driver_artifacts(tmp_path, capsys):
+    """Driver BENCH_rNN.json files wrap the headline in ``parsed``."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))
+    p = tmp_path / "BENCH_r06.json"
+    p.write_text(json.dumps({
+        "rc": 0,
+        "parsed": {"fresh": True, "tpu_paxos3_states_per_sec": 300000.0},
+    }))
+    rc = r.main([str(p), f"--baseline={base}"])
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and v["checked"] == 1
+
+
+def test_main_missing_files_exit_2(tmp_path, capsys):
+    r = _load()
+    rc = r.main([str(tmp_path / "absent.json")])
+    assert rc == 2
+    assert json.loads(capsys.readouterr().out)["ok"] is False
