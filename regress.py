@@ -6,7 +6,7 @@ the stale number masqueraded as the round's result.  This gate makes
 staleness and regressions LOUD:
 
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
-                      [--tolerance=0.85] [--allow-stale]
+                      [--tolerance=0.85] [--allow-stale] [--sanitize]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -22,10 +22,19 @@ Checks, in order:
     the run and the baseline must reach ``tolerance`` × baseline
     (default 0.85: the r4 sweep put same-config run-to-run spread within
     ±5%, so −15% is a real regression, not noise).  Exit 1 on any miss.
+ 3. **Soundness** (``--sanitize``) — the example fleet must pass the
+    interval/bounds sanitizer (``python -m stateright_tpu.models._cli
+    sanitize``; docs/analysis.md JX2xx): a perf number measured by an
+    engine whose kernels may silently clamp indices is not a
+    measurement either.  Adds a ``sanitizer`` section to the verdict;
+    an unclean fleet exits 1.  Opt-in because it imports and traces the
+    whole fleet (~tens of seconds); the stale-artifact rules above are
+    unchanged by it.
 
 The verdict prints as one JSON line: ``{ok, fresh, regressed: [...],
-improved: [...], checked: N}`` — ``regressed`` entries carry the config
-tag, both rates, and the ratio.  Exit 0 only when fresh and clean.
+improved: [...], checked: N[, sanitizer: {...}]}`` — ``regressed``
+entries carry the config tag, both rates, and the ratio.  Exit 0 only
+when fresh and clean.
 """
 
 from __future__ import annotations
@@ -81,10 +90,31 @@ def compare(run: dict, baseline: dict,
     }
 
 
-def main(argv=None) -> int:
+def sanitizer_verdict(fleet=None) -> dict:
+    """Run the fleet soundness sanitizer and summarize for the verdict
+    JSON.  ``fleet`` overrides the runner for tests (any callable
+    returning the fleet exit code)."""
+    import io
+
+    if fleet is None:
+        from stateright_tpu.models._cli import fleet_sanitize as fleet
+    buf = io.StringIO()
+    try:
+        rc = fleet(stream=buf)
+    except Exception as e:  # noqa: BLE001 - an import/trace crash is a
+        # gate failure, not a gate skip
+        return {"clean": False, "error": f"{type(e).__name__}: {e}"}
+    tail = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    return {
+        "clean": rc == 0,
+        "verdict": tail[-1] if tail else "",
+    }
+
+
+def main(argv=None, fleet=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
-    tolerance, allow_stale = DEFAULT_TOLERANCE, False
+    tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -93,6 +123,8 @@ def main(argv=None) -> int:
             tolerance = float(a[len("--tolerance="):])
         elif a == "--allow-stale":
             allow_stale = True
+        elif a == "--sanitize":
+            sanitize = True
         else:
             pos.append(a)
     if pos:
@@ -113,6 +145,11 @@ def main(argv=None) -> int:
     stale_note = run.get("stale")
     if stale_note:
         verdict["stale"] = stale_note
+    # staleness exits 2 regardless of soundness, so don't pay the fleet
+    # import+trace for an artifact that can never validate
+    if sanitize and (verdict["fresh"] or allow_stale):
+        verdict["sanitizer"] = sanitizer_verdict(fleet=fleet)
+        verdict["ok"] = verdict["ok"] and verdict["sanitizer"]["clean"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -125,6 +162,13 @@ def main(argv=None) -> int:
         sys.stderr.write(
             f"regress: {len(verdict['regressed'])} config(s) below "
             f"{tolerance}x of the stored baseline (see stdout JSON)\n"
+        )
+        return 1
+    if "sanitizer" in verdict and not verdict["sanitizer"]["clean"]:
+        sys.stderr.write(
+            "regress: the example fleet FAILS the soundness sanitizer "
+            "(JX2xx; see stdout JSON) — throughput from kernels with "
+            "out-of-range indexing is not a valid measurement\n"
         )
         return 1
     return 0

@@ -1,12 +1,17 @@
 """Preflight static analysis for device-checked models.
 
-Three passes over a model *before* any device launch — the static
+Four passes over a model *before* any device launch — the static
 counterpart to the engines' runtime poison/growth diagnostics:
 
  - :mod:`.jaxpr_audit` — abstractly trace a ``TensorModel``'s
    ``step_rows``/``property_masks`` and walk the jaxpr for purity, dtype,
    shape-contract, and retrace-stability violations (plus a FLOPs/bytes
    perf preflight);
+ - :mod:`.sanitizer` (over :mod:`.interval`) — value-level soundness:
+   interval abstract interpretation proving gather/scatter indices stay on
+   their axes (JX201/JX202) and packed fields inside their widths (JX203),
+   with the ``checkify``-instrumented checked execution mode as the
+   dynamic guard for what the domain can't decide;
  - :mod:`.handler_lint` — AST-lint actor handlers for nondeterminism and
    in-place mutation, and probe one bounded step of the tabulation
    closure for unbounded (ballot-style) field domains;
@@ -21,12 +26,22 @@ and the Explorer's ``/.status``.  Rule catalogue: ``docs/analysis.md``.
 
 from .audit import audit_model, config_signature
 from .report import AuditError, AuditFinding, AuditReport, Severity
+from .sanitizer import (
+    CheckedExecutionError,
+    checkify_kernels,
+    localize_checked_failure,
+    run_sanitizer,
+)
 
 __all__ = [
     "AuditError",
     "AuditFinding",
     "AuditReport",
+    "CheckedExecutionError",
     "Severity",
     "audit_model",
+    "checkify_kernels",
     "config_signature",
+    "localize_checked_failure",
+    "run_sanitizer",
 ]

@@ -36,6 +36,7 @@ import numpy as np
 from .handler_lint import run_handler_lint
 from .jaxpr_audit import run_jaxpr_audit
 from .report import AuditReport, Severity
+from .sanitizer import run_sanitizer
 
 
 _SIMPLE = (int, float, str, bool, bytes, tuple, frozenset, type(None))
@@ -328,6 +329,11 @@ def audit_model(
 
     if twin is not None:
         run_jaxpr_audit(twin, report, model=model, deep=deep, batch=batch)
+        # value-level pass: interval/bounds sanitizer (JX2xx).  Runs in the
+        # light tier too — JX201/JX202 are exactly the silent-clamp class
+        # the spawn preflight exists to abort on, and the interval walk is
+        # a same-order cost as the structural audit's trace.
+        run_sanitizer(twin, report, model=model, batch=batch)
         _check_config_drift(
             model, twin, report, deep and not fresh_twin, sig=sig
         )

@@ -80,7 +80,24 @@ class AuditReport:
         self.findings.append(AuditFinding(rule_id, severity, location, message))
 
     def extend(self, findings) -> None:
-        self.findings.extend(findings)
+        """Append findings, skipping exact duplicates: multiple passes
+        (structural audit + sanitizer) and cache re-extends fold into one
+        report without repeating a finding — so the once-per-model warning
+        print stays one header + one line per distinct diagnostic."""
+        seen = set(self.findings)
+        for f in findings:
+            if f not in seen:
+                self.findings.append(f)
+                seen.add(f)
+
+    def merge(self, other: "AuditReport") -> "AuditReport":
+        """Fold another pass's report into this one: findings dedupe (see
+        :meth:`extend`), metrics merge without overwriting this report's
+        entries.  Returns self."""
+        self.extend(other.findings)
+        for k, v in other.metrics.items():
+            self.metrics.setdefault(k, v)
+        return self
 
     # -- queries -------------------------------------------------------------
 
@@ -156,11 +173,17 @@ class AuditReport:
 
 class AuditError(RuntimeError):
     """Preflight audit found errors; raised by ``spawn_tpu`` before any
-    device work happens.  Carries the full report; silence deliberately
-    with ``CheckerBuilder.skip_audit()``."""
+    device work happens.  Carries the full report plus ``rule_ids`` — the
+    error-severity rule ids, machine-readable for CLI exit paths (the
+    ``audit``/``sanitize`` verbs print and key on them without parsing the
+    rendered message).  Silence deliberately with
+    ``CheckerBuilder.skip_audit()``."""
 
     def __init__(self, report: AuditReport, context: Optional[str] = None):
         self.report = report
+        self.rule_ids: tuple = tuple(
+            sorted({f.rule_id for f in report.errors})
+        )
         prefix = f"{context}: " if context else ""
         super().__init__(
             prefix

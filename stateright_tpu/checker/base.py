@@ -35,6 +35,7 @@ class CheckerBuilder:
         self.timeout_secs: Optional[float] = None
         self._audit_skip = False
         self.telemetry_opts: Optional[dict] = None
+        self.checked_mode = False
 
     # -- configuration -------------------------------------------------------
 
@@ -110,6 +111,27 @@ class CheckerBuilder:
             "profile_steps": profile_steps,
             "profile_dir": profile_dir,
         }
+        return self
+
+    def checked(self, enabled: bool = True) -> "CheckerBuilder":
+        """Checked execution mode: the sanitizer's DYNAMIC guard
+        (``docs/analysis.md``).  The device wavefront runs the same
+        exploration with the model kernels under
+        ``jax.experimental.checkify`` index/nan/div instrumentation and
+        fails loudly — a
+        :class:`~stateright_tpu.analysis.CheckedExecutionError` naming the
+        offending row (index, raw words, decoded state) — instead of
+        letting an out-of-bounds gather silently clamp and prune the
+        search.  Use it when the static sanitizer reports an *undecided*
+        site (JX201/JX202 info) or to confirm a marginal JX203 overflow.
+
+        Contract, mirroring telemetry's: ``checked=False`` (the default)
+        leaves the step jaxpr bit-identical to an engine without the
+        feature (pinned by test); ``checked=True`` pays the checkify
+        instrumentation cost and is a debugging mode, not a bench
+        configuration.  Host checkers ignore the flag (Python raises
+        eagerly there); the sharded engine rejects it for now."""
+        self.checked_mode = bool(enabled)
         return self
 
     def _make_recorder(self, engine: str):

@@ -136,6 +136,17 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
     occ = getattr(checker, "occupancy_stats", None)
     if occ is not None:
         table = occ()
+    # soundness-sanitizer verdict (docs/analysis.md JX2xx): the interval
+    # pass's site counts + fired rules from the model's last audit, plus
+    # whether this run executed under checkify instrumentation
+    sanitizer = None
+    if audit is not None:
+        sanitizer = (audit.metrics or {}).get("sanitizer")
+        if sanitizer is not None:
+            sanitizer = dict(sanitizer)
+            sanitizer["checked_run"] = bool(
+                getattr(checker, "_checked", False)
+            )
     return {
         "done": checker.is_done(),
         "model": type(model).__name__,
@@ -144,6 +155,7 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
         "properties": props,
         "recent_path": snapshot.recent_path,
         "audit": audit.to_json() if audit is not None else None,
+        "sanitizer": sanitizer,
         "table": table,
     }
 

@@ -2,20 +2,23 @@
 e.g. ``examples/paxos.rs:314-395``): subcommands ``check [args]``,
 ``check-sym``, ``explore [addr]``, ``spawn``, with positional arguments.
 Beyond the reference's verbs: ``check-tpu`` / ``check-sym-tpu`` (device
-engines), ``check-auto`` (measured engine selection,
-``CheckerBuilder.spawn_auto``), ``audit`` (the static preflight auditor,
-``stateright_tpu/analysis/``), and ``profile`` (a telemetry-instrumented
-run: flight-recorder JSONL + optional Chrome trace,
+engines; ``--checked`` runs them under checkify instrumentation —
+``CheckerBuilder.checked()``, the sanitizer's dynamic guard),
+``check-auto`` (measured engine selection, ``CheckerBuilder.spawn_auto``),
+``audit`` (the static preflight auditor, ``stateright_tpu/analysis/``),
+``sanitize`` (the interval/bounds soundness sanitizer, JX2xx rules —
+``docs/analysis.md``), and ``profile`` (a telemetry-instrumented run:
+flight-recorder JSONL + optional Chrome trace,
 ``stateright_tpu/telemetry/``, ``docs/telemetry.md``).
 
-Fleet mode — ``python -m stateright_tpu.models._cli audit [MODULE...]`` —
-audits every shipped example (each module exposes ``_audit_models()``),
-printing one report per configuration and exiting non-zero on any
-error-severity finding; CI gates on it.  ``python -m
-stateright_tpu.models._cli profile [MODULE] [--out=F] [--chrome=F]
-[ARGS...]`` profiles one example's configurations through the same
-``_audit_models`` hook (CI runs it as a smoke and uploads the JSONL as a
-workflow artifact).
+Fleet mode — ``python -m stateright_tpu.models._cli audit|sanitize
+[MODULE...]`` — audits/sanitizes every shipped example (each module
+exposes ``_audit_models()``), printing one report per configuration and
+exiting non-zero on any error-severity finding; CI gates on both.
+``python -m stateright_tpu.models._cli profile [MODULE] [--out=F]
+[--chrome=F] [ARGS...]`` profiles one example's configurations through
+the same ``_audit_models`` hook (CI runs it as a smoke and uploads the
+JSONL as a workflow artifact).
 """
 
 from __future__ import annotations
@@ -36,6 +39,7 @@ def run_cli(
     spawn: Optional[Callable[[list], None]] = None,
     audit: Optional[Callable[[list], None]] = None,
     profile: Optional[Callable[[list], None]] = None,
+    sanitize: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -59,15 +63,31 @@ def run_cli(
         audit(rest)
     elif cmd == "profile" and profile is not None:
         profile(rest)
+    elif cmd == "sanitize" and sanitize is not None:
+        sanitize(rest)
     else:
         print("USAGE:")
         print(usage)
         if audit is not None:
             print("  <example> audit    # static preflight audit "
                   "(docs/analysis.md)")
+        if sanitize is not None:
+            print("  <example> sanitize # interval/bounds soundness "
+                  "sanitizer (docs/analysis.md JX2xx)")
         if profile is not None:
             print("  <example> profile [--out=F] [--chrome=F] [ARGS]  "
                   "# telemetry run (docs/telemetry.md)")
+
+
+def pop_checked(rest: list) -> tuple:
+    """Strip ``--checked`` from a verb's arguments: ``(checked, rest)``.
+    The device verbs pass the flag to ``CheckerBuilder.checked()`` — the
+    sanitizer's dynamic guard (``docs/analysis.md``)."""
+    rest = list(rest)
+    checked = "--checked" in rest
+    while "--checked" in rest:
+        rest.remove("--checked")
+    return checked, rest
 
 
 def default_threads() -> int:
@@ -103,6 +123,98 @@ def make_audit_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
             raise SystemExit(1)
 
     return _audit
+
+
+# -- sanitize verb -----------------------------------------------------------
+
+
+def sanitize_and_report(
+    models: Iterable[tuple], stream=None, deep: bool = False
+) -> tuple:
+    """Run the soundness sanitizer view over ``(label, model)`` pairs: one
+    summary line + the JX2xx findings each.  Returns ``(ok, rule_ids)``:
+    ``ok`` iff no error-severity JX2xx finding anywhere, ``rule_ids`` the
+    machine-readable offending rules (the CLI exit path prints them, same
+    contract as ``AuditError.rule_ids``).  The LIGHT audit tier suffices:
+    the sanitizer runs in it, and the deep extras (closure probe, drift
+    re-resolve) contribute no JX2xx findings — the fleet gate should not
+    pay for them twice when CI also runs the audit gate."""
+    from ..analysis import Severity, audit_model
+
+    stream = stream or sys.stdout
+    ok, bad_rules = True, set()
+    for label, model in models:
+        report = audit_model(model, deep=deep)
+        summary = (report.metrics or {}).get("sanitizer")
+        findings = [
+            f for f in report.findings if f.rule_id.startswith("JX2")
+        ]
+        errors = [f for f in findings if f.severity == Severity.ERROR]
+        print(f"--- {label}", file=stream)
+        if summary is None:
+            print(
+                "sanitize: no device twin for this configuration "
+                "(host checkers unaffected)",
+                file=stream,
+            )
+        else:
+            rules = ", ".join(summary.get("rules") or []) or "none"
+            print(
+                f"sanitize: {summary['sites']} indexed site(s) — "
+                f"{summary['proved']} proved in range, "
+                f"{summary['undecided']} undecided (checked-mode "
+                f"candidates); rules fired: {rules}",
+                file=stream,
+            )
+        for f in findings:
+            print("  " + f.format(), file=stream)
+        if errors:
+            ok = False
+            bad_rules.update(f.rule_id for f in errors)
+    return ok, tuple(sorted(bad_rules))
+
+
+def make_sanitize_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as a ``sanitize``
+    CLI verb that exits 1 (naming the rule ids) on error findings."""
+
+    def _sanitize(rest: list) -> None:
+        ok, rules = sanitize_and_report(factory(rest))
+        if not ok:
+            print(f"sanitize: FAILED ({', '.join(rules)})")
+            raise SystemExit(1)
+
+    return _sanitize
+
+
+def fleet_sanitize(names: Optional[list] = None, stream=None) -> int:
+    """Sanitize the whole example fleet (or just ``names``); 0 iff no
+    JX2xx error anywhere.  Same coverage contract as ``fleet_audit``: a
+    module without ``_audit_models`` fails the gate rather than silently
+    shrinking it."""
+    import importlib
+
+    from . import __all__ as all_names
+
+    stream = stream or sys.stdout
+    ok, bad = True, set()
+    for name in names or list(all_names):
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+        factory = getattr(mod, "_audit_models", None)
+        if factory is None:
+            print(
+                f"--- {name}: FAILED — no _audit_models hook (add one so "
+                "the fleet gate covers this example)",
+                file=stream,
+            )
+            ok = False
+            continue
+        mok, rules = sanitize_and_report(factory([]), stream=stream)
+        ok = ok and mok
+        bad.update(rules)
+    verdict = "CLEAN" if ok else f"FAILED ({', '.join(sorted(bad))})"
+    print(f"sanitize fleet: {verdict}", file=stream)
+    return 0 if ok else 1
 
 
 # -- profile verb ------------------------------------------------------------
@@ -244,12 +356,17 @@ def main(argv: Optional[list] = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "audit":
         raise SystemExit(fleet_audit(argv[1:]))
+    if argv and argv[0] == "sanitize":
+        raise SystemExit(fleet_sanitize(argv[1:]))
     if argv and argv[0] == "profile":
         raise SystemExit(fleet_profile(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
           "(docs/analysis.md)")
+    print("  python -m stateright_tpu.models._cli sanitize [MODULE...]")
+    print("    interval/bounds soundness sanitizer over the fleet "
+          "(docs/analysis.md JX2xx); exit 1 on any error finding")
     print("  python -m stateright_tpu.models._cli profile [MODULE] "
           "[--out=F] [--chrome=F] [ARGS...]")
     print("    telemetry-instrumented run; flight-recorder JSONL export "

@@ -26,7 +26,12 @@ from ..actor import Actor, ActorModel, Id, Network, Out
 from ..actor.device_props import exists_actor, forall_actors
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_sanitize_cmd,
+    run_cli,
+)
 
 HUNGRY, HAS_LEFT, DONE = 0, 1, 2
 
@@ -185,6 +190,7 @@ def main(argv=None) -> None:
         check_auto=check_auto,
         explore=explore,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         argv=argv,
     )
 

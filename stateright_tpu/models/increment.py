@@ -12,7 +12,12 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 
 from .. import Model, Property
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_sanitize_cmd,
+    run_cli,
+)
 
 
 @dataclass(frozen=True)
@@ -101,6 +106,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         argv=argv,
     )
 

@@ -30,7 +30,12 @@ from ..actor.register import (
 )
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_sanitize_cmd,
+    run_cli,
+)
 
 
 def Query(req_id):
@@ -341,6 +346,7 @@ def main(argv=None):
         explore=explore,
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         argv=argv,
     )
 

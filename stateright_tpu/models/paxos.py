@@ -36,7 +36,14 @@ from ..actor.register import (
 )
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
-from ._cli import default_threads, make_audit_cmd, make_profile_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_profile_cmd,
+    make_sanitize_cmd,
+    pop_checked,
+    run_cli,
+)
 
 def _ballot_zero() -> tuple:
     return (0, Id(0))
@@ -296,11 +303,13 @@ def main(argv=None):
         ).spawn_dfs().report()
 
     def check_tpu(rest):
+        checked, rest = pop_checked(rest)
         client_count = int(rest[0]) if rest else 2
         target = int(rest[1]) if len(rest) > 1 else None
         print(
             f"Model checking Single Decree Paxos with {client_count} clients "
-            "on the device wavefront engine."
+            "on the device wavefront engine"
+            + (" (checked mode)." if checked else ".")
         )
         m = paxos_model(client_count, 3)
         if m.tensor_model() is None:
@@ -308,7 +317,7 @@ def main(argv=None):
                 "this configuration has no device twin; use `check` (CPU)"
             )
             return
-        b = m.checker()
+        b = m.checker().checked(checked)
         if target:
             b = b.target_states(target)
         b.spawn_tpu().report()
@@ -360,6 +369,7 @@ def main(argv=None):
         explore=explore,
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         profile=make_profile_cmd(_audit_models),
         argv=argv,
     )

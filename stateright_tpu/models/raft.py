@@ -34,7 +34,12 @@ from ..actor import Actor, ActorModel, Id, Network, Out, majority, model_peers
 from ..actor.device_props import exists_actor, forall_actor_pairs
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_sanitize_cmd,
+    run_cli,
+)
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
@@ -304,6 +309,7 @@ def main(argv=None) -> None:
         explore=explore,
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         argv=argv,
     )
 

@@ -24,7 +24,14 @@ from typing import Optional
 from .. import Model, Property
 from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
 from ..symmetry import RewritePlan
-from ._cli import default_threads, make_audit_cmd, make_profile_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_profile_cmd,
+    make_sanitize_cmd,
+    pop_checked,
+    run_cli,
+)
 
 # RM states, ordered so sorting gives a canonical symmetry representative
 WORKING = "working"
@@ -387,17 +394,24 @@ def main(argv=None):
         ).symmetry().spawn_dfs().report()
 
     def check_tpu(rest):
+        checked, rest = pop_checked(rest)
         rm_count = int(rest[0]) if rest else 2
-        print(f"Checking two phase commit with {rm_count} RMs on TPU.")
-        TwoPhaseSys(rm_count).checker().spawn_tpu().report()
+        print(
+            f"Checking two phase commit with {rm_count} RMs on TPU"
+            + (" (checked mode)." if checked else ".")
+        )
+        TwoPhaseSys(rm_count).checker().checked(checked).spawn_tpu().report()
 
     def check_sym_tpu(rest):
+        checked, rest = pop_checked(rest)
         rm_count = int(rest[0]) if rest else 2
         print(
             f"Checking two phase commit with {rm_count} RMs on TPU "
-            "using symmetry reduction."
+            "using symmetry reduction"
+            + (" (checked mode)." if checked else ".")
         )
-        TwoPhaseSys(rm_count).checker().symmetry().spawn_tpu().report()
+        TwoPhaseSys(rm_count).checker().checked(checked).symmetry(
+        ).spawn_tpu().report()
 
     def check_auto(rest):
         rm_count = int(rest[0]) if rest else 2
@@ -429,6 +443,7 @@ def main(argv=None):
         check_auto=check_auto,
         explore=explore,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         profile=make_profile_cmd(_audit_models),
         argv=argv,
     )

@@ -36,7 +36,12 @@ from ..fingerprint import stable_hash
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, WORegister
 from ..symmetry import RewritePlan, rewrite_value
-from ._cli import default_threads, make_audit_cmd, run_cli
+from ._cli import (
+    default_threads,
+    make_audit_cmd,
+    make_sanitize_cmd,
+    run_cli,
+)
 
 
 class WOServer(Actor):
@@ -188,6 +193,7 @@ def main(argv=None):
         explore=explore,
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
+        sanitize=make_sanitize_cmd(_audit_models),
         argv=argv,
     )
 

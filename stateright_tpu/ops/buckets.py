@@ -9,14 +9,25 @@ batch, 50× the cost of everything else combined.  XLA scatters on TPU are
 effectively index-serial, so the fix is architectural, not incremental:
 
  - The table is an array of **buckets** of ``SLOTS`` fingerprints each; a
-   fingerprint's bucket is its low bits.  Membership is ONE wide gather
-   (``[M, SLOTS]`` lines) + a vectorized lane compare — gathers are cheap on
-   TPU (the measured cost is scatters).
- - Batch candidates are sorted ONCE by their *bucket-rotated* fingerprint
-   (low/bucket bits rotated into the MSBs), which simultaneously (a) groups
-   equal fingerprints adjacently for first-occurrence dedup and (b) groups
-   same-bucket candidates adjacently so per-bucket insertion ranks are a
-   cumulative-sum away.
+   fingerprint's bucket is the HIGH bits of ``mix64(fp)`` (one extra
+   splitmix64 round).  The round-5 table-size anomaly (VERDICT.md) traced to
+   the previous derivation — the fingerprint's raw low bits — clustering:
+   splitmix64's final odd multiply avalanches upward only (bit ``k`` of the
+   product depends on input bits ``0..k``), so the low bits of structurally
+   close rows collide ~6x past Poisson and buckets overflowed ``SLOTS`` at
+   25% load.  The remix costs 2 multiplies + 3 shift-xors per candidate and
+   the bucket reads from the multiply's high (fully avalanched) bits;
+   the pinned 2PC-7 occupancy series is back at the Poisson expectation
+   (``tests/test_telemetry.py``), and ``tests/test_buckets.py`` pins
+   avalanche + chi-square on the derivation itself.  Membership is ONE wide
+   gather (``[M, SLOTS]`` lines) + a vectorized lane compare — gathers are
+   cheap on TPU (the measured cost is scatters).
+ - Batch candidates are sorted ONCE by their remixed key (bucket bits are
+   the key's MSBs; EMPTY lanes pin to the maximal key), which simultaneously
+   (a) groups equal fingerprints adjacently for first-occurrence dedup,
+   (b) groups same-bucket candidates adjacently so per-bucket insertion
+   ranks are a cumulative-sum away, and (c) keeps valid candidates a sorted
+   prefix.
  - Every novel candidate's slot is ``occupancy(bucket) + rank`` — slots fill
    densely and never free, so a bucket's occupancy is just the non-EMPTY
    count of its (already gathered) line: no separate counts array exists,
@@ -40,16 +51,35 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .hashing import EMPTY
+from .hashing import EMPTY, mix64, mix64_np
 
 SLOTS = 16  # fingerprints per bucket (one 128-byte line of u64s)
 
 
-def rotate_key(fps: jnp.ndarray, bucket_bits: int) -> jnp.ndarray:
-    """Rotate the bucket (low) bits into the MSBs: sorting by the result
-    groups candidates by bucket, with equal fingerprints adjacent."""
-    b = jnp.uint64(bucket_bits)
-    return (fps << (jnp.uint64(64) - b)) | (fps >> b)
+def bucket_key(fps: jnp.ndarray) -> jnp.ndarray:
+    """Sort/derivation key: ``mix64(fp)`` with EMPTY lanes pinned to the
+    maximal key.  A bucket is the key's high ``bucket_bits`` bits, so
+    sorting by the key groups candidates by bucket with equal fingerprints
+    adjacent AND keeps valid candidates a sorted prefix (EMPTY sorts last).
+    The one valid fp whose mix64 equals EMPTY remaps to ``EMPTY - 1`` —
+    same bucket (high bits agree), prefix invariant preserved; colliding
+    with it is the same accepted 2^-64 risk class as the EMPTY sentinel
+    itself (``ops/hashing.py``)."""
+    k = mix64(fps)
+    k = jnp.where(k == EMPTY, EMPTY - jnp.uint64(1), k)
+    return jnp.where(fps == EMPTY, EMPTY, k)
+
+
+def bucket_of(fps, nbuckets: int) -> np.ndarray:
+    """Host-side bucket derivation (numpy): the bucket ``bucket_insert``
+    and ``host_bucket_rehash`` place ``fps`` in for an ``nbuckets``-bucket
+    table.  Shared by the rehash, the tests' collision construction, and
+    the chi-square diagnostics."""
+    assert nbuckets & (nbuckets - 1) == 0
+    bits = int(nbuckets).bit_length() - 1
+    k = mix64_np(fps)
+    k = np.where(k == np.uint64(EMPTY), np.uint64(EMPTY) - np.uint64(1), k)
+    return (k >> np.uint64(64 - bits)).astype(np.int64)
 
 
 def bucket_insert(
@@ -110,13 +140,14 @@ def bucket_insert(
     nbuckets = nslots // SLOTS
     assert nbuckets & (nbuckets - 1) == 0, "bucket count must be a power of two"
     bucket_bits = int(nbuckets).bit_length() - 1
-    bmask = jnp.uint64(nbuckets - 1)
 
-    order = jnp.argsort(rotate_key(fps, bucket_bits))
+    key = bucket_key(fps)
+    order = jnp.argsort(key)
     sfp = fps[order]
+    skey = key[order]
     valid = sfp != EMPTY
     first = jnp.concatenate([jnp.ones((1,), bool), sfp[1:] != sfp[:-1]]) & valid
-    bucket = (sfp & bmask).astype(jnp.int32)
+    bucket = (skey >> jnp.uint64(64 - bucket_bits)).astype(jnp.int32)
     n_valid = jnp.sum(valid).astype(jnp.int32)
 
     # membership + occupancy-base gathers, windowed over the VALID PREFIX
@@ -257,8 +288,10 @@ def occupancy_stats(table_fp) -> dict:
     ``/.status`` (``"table"``), and the audit report metrics.
 
     ``histogram[k]`` counts buckets holding exactly ``k`` fingerprints;
-    a heavy tail vs Poisson(λ = occupied/nbuckets) means the low bits of
-    the fingerprint mix are clustering.
+    a heavy tail vs Poisson(λ = occupied/nbuckets) means the bucket
+    derivation (high bits of ``mix64(fp)``; see :func:`bucket_of`) is
+    clustering — exactly the round-5 anomaly signature the old low-bit
+    derivation produced.
     """
     t = np.asarray(table_fp).reshape(-1, SLOTS)
     per_bucket = (t != EMPTY).sum(axis=1)
@@ -303,7 +336,7 @@ def host_bucket_rehash(
     p = table_payload[occ]
     out_fp = np.full(new_nbuckets * SLOTS, EMPTY, np.uint64)
     out_pl = np.zeros(new_nbuckets * SLOTS, np.uint64)
-    bucket = (f & np.uint64(new_nbuckets - 1)).astype(np.int64)
+    bucket = bucket_of(f, new_nbuckets)
     order = np.argsort(bucket, kind="stable")
     bucket, f, p = bucket[order], f[order], p[order]
     start = np.searchsorted(bucket, bucket, side="left")

@@ -46,6 +46,20 @@ def mix64(h: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def mix64_np(h) -> np.ndarray:
+    """Host-side :func:`mix64` (numpy, no device): must match the device
+    remix bit-for-bit — ``host_bucket_rehash`` derives the same bucket for
+    the same fingerprint that the device insert did."""
+    h = np.asarray(h, np.uint64)
+    with np.errstate(over="ignore"):  # u64 wrap is the point of the mix
+        h = h ^ (h >> np.uint64(30))
+        h = h * _M1
+        h = h ^ (h >> np.uint64(27))
+        h = h * _M2
+        h = h ^ (h >> np.uint64(31))
+    return h
+
+
 def fold64(h: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     """Fold one word into the running digest (= host ``fingerprint.fold64``)."""
     return mix64((h ^ w) + _GAMMA)

@@ -1129,6 +1129,31 @@ class CompiledActorTensor(TensorModel):
             ]
         return self._device_consts
 
+    def row_domain(self):
+        """Declared value bounds for the static sanitizer
+        (``stateright_tpu/analysis/``, ``docs/analysis.md``).
+
+        The compiled row's fields are bound by their actual UNIVERSES, not
+        their bit widths: ``a{i}`` holds a state code ``< len(states[i])``
+        (a 3-bit field over 5 codes proves ``< 5``), and each network slot
+        word is either ``EMPTY`` or ``code << COUNT_BITS | count`` with
+        ``code < len(envs)`` — which is exactly what lets the interval
+        pass prove every ``trans[sc * ne + ecode]`` table gather in range
+        instead of reporting the whole kernel undecidable."""
+        from .tensor_model import RowDomain
+
+        bounds = {
+            f"a{i}": max(0, len(self._states[i]) - 1)
+            for i in range(self.n_actors)
+        }
+        dom = RowDomain.from_packer(self.pk, field_bounds=bounds,
+                                    width=self.width)
+        max_code = max(0, len(self._envs) - 1)
+        slot_hi = (max_code << COUNT_BITS) | COUNT_MASK
+        for w in range(self.pw, self.width):
+            dom.declare_word(w, slot_hi, may_empty=True)
+        return dom
+
     def step_rows(self, rows):
         import jax.numpy as jnp
 

@@ -438,6 +438,16 @@ class ShardedTpuChecker(WavefrontChecker):
                 "the Pallas insert kernel is single-device only for now; "
                 "drop pallas=True or use spawn_tpu() without devices/mesh"
             )
+        if getattr(options, "checked_mode", False):
+            # checkify's error carry does not compose with this engine's
+            # shard_map collectives on the pinned jax yet; the checked
+            # exploration itself is engine-independent, so the guidance is
+            # to reproduce on the single-device engine
+            raise NotImplementedError(
+                "checked mode (CheckerBuilder.checked()) is single-device "
+                "only for now: run spawn_tpu() without devices/mesh to "
+                "reproduce with checkify instrumentation"
+            )
         if options.timeout_secs is not None:
             # timers fire per process at slightly different instants — one
             # controller would break the lockstep collectives while others

@@ -95,6 +95,10 @@ _TFP, _TPL, _QROWS, _QFP, _QEBITS, _QDEPTH = 0, 1, 2, 3, 4, 5
 _HEAD, _TAIL, _UNIQUE, _SCOUNT, _DISC, _MAXDEPTH, _STATUS = (
     6, 7, 8, 9, 10, 11, 12,
 )
+# checked mode only: the checkify Error pytree rides the carry tail
+# (snapshots zip against _SNAPSHOT_KEYS and so deliberately drop it — a
+# resumed checked run re-seeds an all-clear error)
+_ERR = 13
 
 _SNAPSHOT_KEYS = (
     "table_fp", "table_parent", "q_rows", "q_fp", "q_ebits",
@@ -120,7 +124,8 @@ def _stats_np(carry) -> np.ndarray:
 
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
-                  sym: bool = False, cand: Optional[int] = None):
+                  sym: bool = False, cand: Optional[int] = None,
+                  checked: bool = False):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -133,6 +138,19 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     count exceeds it reports ``_STATUS_CAND_FULL`` without writing anything
     and the host doubles the budget and replays — self-tuning, like the
     other capacities.
+
+    ``checked`` is the sanitizer's dynamic guard
+    (``stateright_tpu/analysis/sanitizer.py``): the MODEL kernels
+    (``property_masks`` + ``step_rows``) run under
+    ``jax.experimental.checkify`` index/nan/div instrumentation, with a
+    sticky failure flag threaded through the while-loop carry;
+    the loop stops at the first failing batch and the host loop raises a
+    :class:`~stateright_tpu.analysis.CheckedExecutionError` naming the
+    offending row.  Only the model kernels are wrapped — the engine's
+    insert deliberately scatters out of range with ``mode='drop'`` (dead
+    lanes), which the OOB check would flag by design.  ``checked=False``
+    is bit-identical to an engine built before the flag existed (pinned
+    by test, same contract as telemetry).
     """
     width, arity = tensor.width, tensor.max_actions
     m = batch * arity
@@ -150,14 +168,23 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     init_rows_np = np.asarray(tensor.init_rows(), dtype=np.uint64)
     n_init = init_rows_np.shape[0]
 
+    if checked:
+        from ..analysis.sanitizer import checkify_kernels, error_flag
+
+        # the carry threads only a BOOLEAN "some check failed" scalar:
+        # checkify Error pytrees mint fresh error codes per trace, so the
+        # full error cannot ride a carry across jit boundaries — and the
+        # host localizes by re-running the failing batch row-by-row, which
+        # reconstructs the full message anyway
+        checked_kernels = checkify_kernels(tensor)
+
     def record_first(disc, i, hit, fps):
         """First-wins discovery of property ``i`` at the first hit row."""
         fp = fps[jnp.argmax(hit)]
         take = (disc[i] == jnp.uint64(0)) & jnp.any(hit)
         return disc.at[i].set(jnp.where(take, fp, disc[i]))
 
-    def eval_props(rows, fps, live, ebits, disc):
-        masks = tensor.property_masks(rows)  # [B, P] bool
+    def eval_props(masks, fps, live, ebits, disc):
         for i, p in enumerate(props):
             if p.expectation is Expectation.ALWAYS:
                 disc = record_first(disc, i, live & ~masks[..., i], fps)
@@ -188,8 +215,12 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
 
     def step(carry):
         """Pop one batch, expand, dedup+insert, append novel rows."""
-        (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
-         unique, scount, disc, maxdepth, status) = carry
+        if checked:
+            (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+             unique, scount, disc, maxdepth, status, err) = carry
+        else:
+            (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+             unique, scount, disc, maxdepth, status) = carry
         n_avail = tail - head
         rows = jax.lax.dynamic_slice(qrows, (head, jnp.int32(0)), (batch, width))
         fps = jax.lax.dynamic_slice(qfp, (head,), (batch,))
@@ -197,7 +228,21 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         depths = jax.lax.dynamic_slice(qdepth, (head,), (batch,))
         live = jnp.arange(batch, dtype=jnp.int32) < n_avail
 
-        ebits, disc = eval_props(rows, fps, live, ebits, disc)
+        if checked:
+            # both model kernels under checkify; sticky failure flag.
+            # Dead lanes (past n_avail) hold queue padding/garbage the
+            # unchecked engine discards via the live mask AFTER computing
+            # on them — checkify would check that garbage and abort on
+            # phantom rows, so substitute a known-good init row first
+            # (outputs for those lanes are discarded identically below)
+            safe_rows = jnp.where(
+                live[:, None], rows, jnp.asarray(init_rows_np[0])[None, :]
+            )
+            err_new, (masks, succ, valid) = checked_kernels(safe_rows)
+            err = err | error_flag(err_new)
+        else:
+            masks = tensor.property_masks(rows)  # [B, P] bool
+        ebits, disc = eval_props(masks, fps, live, ebits, disc)
         maxdepth = jnp.maximum(
             maxdepth, jnp.max(jnp.where(live, depths, 0)).astype(jnp.int32)
         )
@@ -205,7 +250,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # once every property has a discovery.
         elive = live & ~all_discovered(disc)
 
-        succ, valid = tensor.step_rows(rows)  # [B, A, W], [B, A]
+        if not checked:
+            succ, valid = tensor.step_rows(rows)  # [B, A, W], [B, A]
         if boundary_fn is not None:
             # mirror the host checkers: out-of-boundary successors are
             # neither counted nor enqueued, and a state whose successors
@@ -277,6 +323,9 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                 jnp.int32(_STATUS_POISON),
                 status,
             )
+        if checked:
+            return (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+                    unique, scount, disc, maxdepth, status, err)
         return (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
                 unique, scount, disc, maxdepth, status)
 
@@ -286,6 +335,9 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         go = go & (carry[_TAIL] > carry[_HEAD]) & ~all_discovered(carry[_DISC])
         if target is not None:
             go = go & (carry[_UNIQUE] < jnp.int64(target))
+        if checked:
+            # stop at the first failing batch: the host raises from it
+            go = go & ~carry[_ERR]
         return go
 
     def stats_of(carry):
@@ -347,6 +399,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                  jnp.zeros((max(n_props, 1),), jnp.uint64),
                  jnp.int32(0),
                  status)
+        if checked:
+            carry = carry + (jnp.bool_(False),)
         return carry, stats_of(carry)
 
     return init_fn, run_fn
@@ -415,6 +469,9 @@ class TpuChecker(WavefrontChecker):
         if pallas is None:
             pallas = os.environ.get("STATERIGHT_TPU_PALLAS", "") == "1"
         self._pallas = bool(pallas)
+        # checked execution mode (builder.checked() / --checked): checkify
+        # instrumentation of the model kernels; see _build_engine
+        self._checked = bool(getattr(options, "checked_mode", False))
         if batch is None:
             batch = frontier_capacity if frontier_capacity else 1 << 11
         self._batch = max(8, batch)
@@ -438,7 +495,7 @@ class TpuChecker(WavefrontChecker):
             self.tensor._run_cache = cache
         sym = self._symmetry is not None
         key = (cap, qcap, batch, cand, self._steps, self._target,
-               self._pallas, sym)
+               self._pallas, sym, self._checked)
         eng = cache.get(key)
         if (
             self.flight_recorder is not None
@@ -461,9 +518,31 @@ class TpuChecker(WavefrontChecker):
             eng = _build_engine(
                 self.tensor, self._props, cap, qcap, batch, self._steps,
                 self._target, pallas=self._pallas, sym=sym, cand=cand,
+                checked=self._checked,
             )
             cache[key] = eng
         return eng
+
+    def _raise_on_checked_error(self, carry, head: int, tail: int,
+                                batch: int) -> None:
+        """Checked mode: if the carry's failure flag is set, localize the
+        offending row in the last popped batch window (per-row checkified
+        replay reconstructs the full check message) and raise
+        CheckedExecutionError."""
+        if not bool(np.asarray(carry[_ERR])):
+            return
+        from ..analysis.sanitizer import localize_checked_failure
+
+        qrows = np.asarray(carry[_QROWS])
+        # the failing batch sits at [head - batch, head) after a normal
+        # pop, or [head, head + batch) when an overflow replay kept the
+        # cursor — scan the union, clipped at tail (rows past tail are
+        # unwritten padding the run never popped); clean rows re-check
+        # clean
+        lo = max(0, head - batch)
+        hi = min(qrows.shape[0], max(head, tail))
+        hi = min(hi, head + batch)
+        localize_checked_failure(self.tensor, qrows[lo:hi])
 
     def _carry_to_snapshot(self, carry, cap, qcap, cand=None) -> dict:
         snap = {
@@ -567,6 +646,9 @@ class TpuChecker(WavefrontChecker):
                     carry_np, cap, qcap, batch, arity, st, cand
                 )
                 carry = [jnp.asarray(c) for c in carry_np]
+            if self._checked:
+                # snapshots never carry the error flag: re-seed all-clear
+                carry = list(carry) + [jnp.bool_(False)]
         else:
             while True:
                 init_fn, _ = self._engine(cap, qcap, batch, cand)
@@ -607,6 +689,10 @@ class TpuChecker(WavefrontChecker):
             with self._live_lock:
                 self._live = (scount, unique, maxdepth)
                 self._live_disc = np.asarray(disc)
+            if self._checked and len(carry) > _ERR:
+                # a failed kernel check raises HERE, before any growth or
+                # checkpoint handling touches the (possibly garbage) carry
+                self._raise_on_checked_error(carry, head, tail, batch)
             if rec is not None:
                 # all fields below are host state the loop already synced —
                 # the telemetry cost is one dict append per block
@@ -650,6 +736,11 @@ class TpuChecker(WavefrontChecker):
                     )
                     if status == _STATUS_CAND_FULL:
                         rec.add("compaction_hits")
+                # the checkify Error pytree (checked mode) is not a numpy
+                # buffer: strip it around host-side growth and re-seed
+                # all-clear after (the error check above already passed)
+                err_tail = carry[_ERR:] if self._checked else []
+                carry = carry[:_ERR] if self._checked else carry
                 if status == _STATUS_CAND_FULL:
                     # the candidate budget is an engine parameter, not a
                     # carry buffer: double it, clear the carry's status word
@@ -663,6 +754,7 @@ class TpuChecker(WavefrontChecker):
                             batch, arity, _STATUS_TABLE_FULL, cand,
                         )
                         carry = [jnp.asarray(c) for c in carry_np]
+                    carry = list(carry) + err_tail
                     stats = None
                     continue
                 carry_np = [np.asarray(c) for c in carry]
@@ -682,7 +774,7 @@ class TpuChecker(WavefrontChecker):
                     rec.add_bytes(
                         h2d=sum(a.nbytes for a in carry_np if a.ndim)
                     )
-                carry = [jnp.asarray(c) for c in carry_np]
+                carry = [jnp.asarray(c) for c in carry_np] + err_tail
                 stats = None
                 continue
             if self._stop.is_set():

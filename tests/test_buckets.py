@@ -10,9 +10,10 @@ import jax.numpy as jnp
 from stateright_tpu.ops.buckets import (
     SLOTS,
     bucket_insert,
+    bucket_of,
     host_bucket_rehash,
 )
-from stateright_tpu.ops.hashing import EMPTY
+from stateright_tpu.ops.hashing import EMPTY, mix64_np
 
 
 def np_u64(x):
@@ -97,8 +98,12 @@ def test_payloads_stored_for_novel_entries():
 
 def test_bucket_overflow_is_clean():
     nbuckets = 4
-    # SLOTS+1 distinct fps in the same bucket (same low bits)
-    fps = [(i << 2) * nbuckets + 1 for i in range(SLOTS + 1)]
+    # SLOTS+1 distinct fps the mix64 derivation places in the SAME bucket
+    fps, x = [], 1
+    while len(fps) < SLOTS + 1:
+        if int(bucket_of(np.uint64(x), nbuckets)) == 0:
+            fps.append(x)
+        x += 1
     state = fresh(nbuckets)
     state, _, n_new, overflow = insert(state, fps)
     assert overflow
@@ -204,3 +209,52 @@ def test_host_rehash_round_trip():
     state2 = (jnp.asarray(tfp), jnp.asarray(tpl))
     state2, _, n_new2, _ = insert(state2, [123456789, int(fps[0])])
     assert n_new2 == 1
+
+
+# ---------------------------------------------------------------------------
+# the bucket-mix fix (ROADMAP table-size anomaly): avalanche + chi-square
+# ---------------------------------------------------------------------------
+
+
+def test_mix64_avalanche():
+    """Flipping any single input bit must flip ~half the output bits of the
+    remix the bucket derivation reads (mean avalanche weight near 32, and
+    every input bit must propagate into the TOP bits, where the bucket
+    lives — the raw low-bit derivation failed exactly this)."""
+    rng = np.random.default_rng(7)
+    xs = rng.integers(0, 1 << 63, 256, dtype=np.uint64)
+    base = mix64_np(xs)
+    top16 = np.uint64(0xFFFF_0000_0000_0000)
+    for bit in range(64):
+        flipped = mix64_np(xs ^ np.uint64(1 << bit))
+        diff = base ^ flipped
+        # mean bits flipped across samples, whole word and top-16 slice
+        weights = np.array([bin(int(d)).count("1") for d in diff])
+        assert 24 <= weights.mean() <= 40, (bit, weights.mean())
+        top = np.array([bin(int(d & top16)).count("1") for d in diff])
+        assert top.mean() >= 4, (bit, top.mean())  # ~8 expected of 16
+
+
+@pytest.mark.parametrize(
+    "stream",
+    [
+        np.arange(1, (1 << 14) + 1, dtype=np.uint64),  # dense counter
+        np.arange(1, (1 << 14) + 1, dtype=np.uint64) * np.uint64(97),
+        (np.arange(1, (1 << 14) + 1, dtype=np.uint64) << np.uint64(12)),
+    ],
+    ids=["counter", "strided", "shifted"],
+)
+def test_bucket_chi_square_on_structured_streams(stream):
+    """The bucket derivation must spread STRUCTURED fingerprint streams
+    uniformly: chi-square over 256 buckets at 64 expected per bucket.  The
+    pre-fix low-bit derivation fails all three of these catastrophically
+    (the dense counter puts everything in 256 consecutive buckets of the
+    fingerprint's low bits)."""
+    nbuckets = 256
+    counts = np.bincount(bucket_of(stream, nbuckets), minlength=nbuckets)
+    expect = stream.size / nbuckets
+    chi2 = float(((counts - expect) ** 2 / expect).sum())
+    # df = 255: mean 255, sd ~22.6; 400 is a > 6-sigma ceiling
+    assert chi2 < 400.0, chi2
+    # and no bucket anywhere near a SLOTS-deep pile-up at this load
+    assert counts.max() < 2 * expect

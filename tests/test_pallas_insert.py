@@ -66,13 +66,19 @@ def test_pallas_matches_xla_insert(m, nbuckets):
 
 
 def test_pallas_overflow_writes_nothing():
+    from stateright_tpu.ops.buckets import bucket_of
+
     nbuckets = 4
     tfp = jnp.full((nbuckets * SLOTS,), EMPTY, jnp.uint64)
     tpl = jnp.zeros((nbuckets * SLOTS,), jnp.uint64)
-    # >SLOTS distinct fps in one bucket: guaranteed overflow
-    fps = jnp.asarray(
-        (np.arange(1, SLOTS + 2, dtype=np.uint64) * nbuckets), jnp.uint64
-    )
+    # >SLOTS distinct fps in one bucket (constructed through the mix64
+    # bucket derivation): guaranteed overflow
+    colliding, x = [], 1
+    while len(colliding) < SLOTS + 1:
+        if int(bucket_of(np.uint64(x), nbuckets)) == 0:
+            colliding.append(x)
+        x += 1
+    fps = jnp.asarray(np.asarray(colliding, np.uint64))
     payloads = jnp.arange(SLOTS + 1, dtype=jnp.uint64)
     out = bucket_insert(tfp, tpl, fps, payloads, window=8, use_pallas=True)
     assert bool(out[4]) and int(out[3]) == 0  # overflow, nothing counted

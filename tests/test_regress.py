@@ -123,3 +123,63 @@ def test_main_missing_files_exit_2(tmp_path, capsys):
     rc = r.main([str(tmp_path / "absent.json")])
     assert rc == 2
     assert json.loads(capsys.readouterr().out)["ok"] is False
+
+
+def test_sanitizer_section_gates_the_verdict(tmp_path, capsys):
+    """--sanitize adds a ``sanitizer`` section (the fleet soundness gate,
+    docs/analysis.md JX2xx): a clean fleet leaves a fresh run passing, an
+    unclean fleet fails it with exit 1 — and the stale-artifact rules are
+    unchanged (stale + unclean still exits 2 on staleness first)."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(
+        {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0}
+    ))
+
+    def clean_fleet(stream=None):
+        print("sanitize fleet: CLEAN", file=stream)
+        return 0
+
+    def dirty_fleet(stream=None):
+        print("sanitize fleet: FAILED (JX201)", file=stream)
+        return 1
+
+    rc = r.main([str(run), f"--baseline={base}", "--sanitize"],
+                fleet=clean_fleet)
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and v["ok"] is True
+    assert v["sanitizer"] == {"clean": True,
+                              "verdict": "sanitize fleet: CLEAN"}
+
+    rc = r.main([str(run), f"--baseline={base}", "--sanitize"],
+                fleet=dirty_fleet)
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and v["ok"] is False
+    assert v["sanitizer"]["clean"] is False
+    assert "JX201" in v["sanitizer"]["verdict"]
+
+    # without the flag the verdict is untouched (no import of the fleet)
+    rc = r.main([str(run), f"--baseline={base}"])
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and "sanitizer" not in v
+
+    # staleness still wins: a stale artifact exits 2 before sanitizing
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({"fresh": False}))
+    rc = r.main([str(stale), f"--baseline={base}", "--sanitize"],
+                fleet=clean_fleet)
+    assert rc == 2
+
+
+def test_sanitizer_verdict_crash_is_a_failure():
+    """An import/trace crash in the fleet runner is a gate FAILURE, never
+    a silent skip."""
+    r = _load()
+
+    def broken(stream=None):
+        raise RuntimeError("boom")
+
+    v = r.sanitizer_verdict(fleet=broken)
+    assert v["clean"] is False and "boom" in v["error"]

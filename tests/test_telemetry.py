@@ -321,13 +321,18 @@ def test_telemetry_overhead_under_3pct_on_2pc7():
 
 @pytest.mark.slow
 def test_2pc7_occupancy_time_series_pins_table_anomaly():
-    """The pinned 2PC-7 occupancy time series.  The run is deterministic
-    (fixed caps, no RNG), so the series is exact; what it must capture is
-    the VERDICT.md table-size anomaly signature: the engine grows the
-    table on single-bucket overflow (a bucket hits SLOTS=16) at loads
-    where the Poisson model the <=25%-load policy assumes predicts
-    essentially zero full buckets — i.e. the fingerprints' low bits
-    cluster."""
+    """The pinned 2PC-7 occupancy time series, POST bucket-mix fix.  The
+    run is deterministic (fixed caps, no RNG), so the series is exact.
+
+    History: the pre-fix series was the first committed evidence for the
+    VERDICT.md table-size anomaly — the raw-low-bit bucket derivation
+    clustered so badly that a bucket overflowed SLOTS=16 at load 0.25
+    (full_buckets=1 vs poisson_full_expect=0.17, ~6x the Poisson model),
+    and max_bucket rode 14-16 from mid-run on.  The fix (bucket = high
+    bits of ``mix64(fp)``, ``ops/buckets.bucket_of``) must keep the same
+    deterministic series INSIDE the Poisson envelope: zero full buckets
+    where the model expects a fraction of one, no single-bucket-overflow
+    growth at all (growth is load/queue-driven only)."""
     c = (
         TwoPhaseSys(7).checker().telemetry(occupancy_every=1, capacity=512)
         .spawn_tpu(sync=True, capacity=1 << 16, batch=1024,
@@ -342,27 +347,27 @@ def test_2pc7_occupancy_time_series_pins_table_anomaly():
     assert occupied == sorted(occupied)
     assert occ[-1]["at"] == "final"
     assert occ[-1]["occupied"] == TPC7_UNIQUE
-    # growth trail: the run grows through table_full events, each sampled
+    # growth trail: the run still grows through table_full events (the
+    # <=25%-load policy), each sampled for free at the boundary
     growth = [g for g in rec.records("growth")
               if g["status"] == "table_full"]
     assert growth, "2pc-7 at 64k initial slots must grow the table"
-    # THE ANOMALY SIGNATURE (deterministic: fixed caps, no RNG).  The
-    # <=25%-load growth policy assumes Poisson-spread buckets, under which
-    # a full bucket is a fraction-of-a-bucket event at these loads — but
-    # the observed series has a bucket actually overflowing SLOTS=16 at
-    # load 0.25 (occupied=131480, nbuckets=32768: full_buckets=1 vs
-    # poisson_full_expect=0.17, ~6x the model), and max_bucket rides 14-16
-    # from mid-run on.  The low bits of the fingerprint mix cluster; this
-    # series is the first committed evidence for the VERDICT.md anomaly.
-    assert max(o["max_bucket"] for o in occ) == 16
-    overflowed = [
-        o for o in occ
-        if o["full_buckets"] >= 1 and o["poisson_full_expect"] < 0.2
-    ]
-    assert overflowed, (
-        "expected a bucket-overflow sample beyond the Poisson model "
-        f"(series: {[(o['full_buckets'], round(o['poisson_full_expect'], 3)) for o in occ]})"
-    )
+    # THE ANOMALY IS GONE (acceptance: full buckets within 2x Poisson at
+    # load 0.25, was ~6x).  Post-fix the deterministic series never
+    # overflows a bucket: max_bucket tops out at 15 (observed: 15 at the
+    # load-0.25 growth boundaries, 11 at the final 0.141 load), and every
+    # sample's full-bucket count sits within 2x of the Poisson
+    # expectation — which at these loads means zero.
+    assert max(o["max_bucket"] for o in occ) <= 15
+    for o in occ:
+        assert o["full_buckets"] <= 2 * max(o["poisson_full_expect"], 0.5), (
+            "bucket clustering is back past the Poisson envelope: "
+            f"{(o['at'], o['load_factor'], o['full_buckets'], o['poisson_full_expect'])}"
+        )
+    # the load-0.25 window specifically (the pre-fix failure point):
+    # samples exist there and carry zero full buckets
+    at_quarter = [o for o in occ if 0.24 <= o["load_factor"] <= 0.26]
+    assert at_quarter and all(o["full_buckets"] == 0 for o in at_quarter)
 
 
 # -- /.metrics ---------------------------------------------------------------
