@@ -125,7 +125,8 @@ _LINE_KEYS = (
     "metric", "value", "unit", "vs_baseline",
     "fresh", "stale", "validated_at", "error",
     "tpu_paxos3_states_per_sec", "tpu_paxos3_unique", "tpu_paxos3_sec",
-    "cpu_baseline_states_per_sec", "cpu_baseline_src", "cpu_cores",
+    "cpu_baseline_states_per_sec", "cpu_baseline_src",
+    "cpu_baseline_engine", "cpu_cores",
     "cpu_load1", "baseline_def", "insert_path", "parity", "details",
 )
 
@@ -173,6 +174,8 @@ def _compute_headline() -> dict:
         out["cpu_baseline_states_per_sec"] = cpu_base
         out["cpu_baseline_src"] = cpu_src
     out["baseline_def"] = "uncontended single-core CPU BFS (this framework)"
+    if EXTRAS.get("cpu_baseline_engine"):
+        out["cpu_baseline_engine"] = EXTRAS["cpu_baseline_engine"]
     # -- value: fresh chip number if we have one, else last validated --
     tpu_sps = EXTRAS.get("tpu_paxos3_states_per_sec")
     pallas_sps = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
@@ -299,6 +302,12 @@ def record_validated() -> None:
         "validated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "provenance": "bench.py full run, parity gates passed",
     }
+    # per-stage attribution travels with the validated number so
+    # ``regress.py --stages`` can compare like against like
+    if EXTRAS.get("tpu_paxos3_stages"):
+        doc["tpu_paxos3_stages"] = EXTRAS["tpu_paxos3_stages"]
+    if EXTRAS.get("tpu_phases"):
+        doc["tpu_phases"] = EXTRAS["tpu_phases"]
     pallas = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
     if pallas and pallas > (doc["tpu_paxos3_states_per_sec"] or 0):
         doc["tpu_paxos3_states_per_sec"] = pallas
@@ -313,6 +322,9 @@ def record_validated() -> None:
             "cpu_paxos3_states_per_sec"
         ]
         doc["cpu_load1"] = EXTRAS.get("cpu_load1")
+        # which engine measured the stored rate: the native baseline and
+        # the python fallback are NOT comparable across rounds
+        doc["cpu_baseline_engine"] = EXTRAS.get("cpu_baseline_engine")
     elif cpu_stored:
         doc["cpu_paxos3_uncontended_states_per_sec"] = cpu_stored
     if doc["tpu_paxos3_states_per_sec"] is None:
@@ -366,7 +378,12 @@ def cpu_phase(on_primary_done=lambda: None) -> dict:
     }
 
     # primary baseline FIRST: vs_baseline needs it, and every emit after
-    # this carries it
+    # this carries it.  The denominator is the COMPILED single-core
+    # baseline when the native module builds (stateright_tpu/native/bfs.cpp
+    # — XLA-CPU step kernels + native visited set/queue; ROADMAP "so
+    # vs_baseline stops flattering the engine"); the pure-Python thread
+    # BFS is the fallback AND is always measured for continuity
+    # (``cpu_paxos3_python_states_per_sec``).
     cpu_p3, dt = timed(
         lambda: paxos_model(3)
         .checker()
@@ -374,10 +391,29 @@ def cpu_phase(on_primary_done=lambda: None) -> dict:
         .target_states(CPU_TARGET)
         .spawn_bfs()
     )
-    out["cpu_paxos3_states_per_sec"] = round(cpu_p3.state_count() / dt, 1)
+    out["cpu_paxos3_python_states_per_sec"] = round(
+        cpu_p3.state_count() / dt, 1
+    )
+    out["cpu_paxos3_states_per_sec"] = out["cpu_paxos3_python_states_per_sec"]
     out["cpu_paxos3_states"] = cpu_p3.state_count()
     out["cpu_paxos3_sec"] = round(dt, 3)
     out["cpu_paxos3_note"] = f"prefix run, target_states={CPU_TARGET}"
+    out["cpu_baseline_engine"] = "python-thread-bfs"
+    try:
+        from stateright_tpu.native.baseline import compiled_cpu_bfs
+
+        nat = compiled_cpu_bfs(paxos_model(3), target=CPU_TARGET, batch=2048)
+        if nat is not None:
+            out["cpu_paxos3_states_per_sec"] = nat["states_per_sec"]
+            out["cpu_paxos3_states"] = nat["states"]
+            out["cpu_paxos3_sec"] = nat["secs"]
+            out["cpu_baseline_engine"] = "native-cpp-bfs"
+        else:
+            out["cpu_baseline_engine_note"] = (
+                "native module unavailable; python fallback"
+            )
+    except Exception as e:  # noqa: BLE001 - the baseline never voids the run
+        out["cpu_native_baseline_error"] = f"{type(e).__name__}: {e}"
     # the baseline measurement is done — only NOW may the probe child
     # start: on a single-core box a concurrently-importing probe steals
     # ~half the primary run's CPU and poisons the uncontended baseline
@@ -523,12 +559,20 @@ def tpu_phase() -> dict:
 
     threading.Thread(target=heartbeat, daemon=True).start()
 
+    phases: dict = {}  # per-phase wall breakdown (docs/perf.md)
+    out["tpu_phases"] = phases
     _mark("backend-init (jax.devices)")
+    t_init = time.monotonic()
     out["tpu_devices"] = _device_names()
+    phases["backend_init_secs"] = round(time.monotonic() - t_init, 3)
     _mark("backend-up")
     _persist(out)
 
-    # parity gate on device (capacity sized so no growth event interrupts)
+    # parity gate on device (capacity sized so no growth event interrupts).
+    # "compile ..." marks delimit cold-compile windows: the parent's
+    # watchdog uses the last mark to tell a backend-init hang from an
+    # engine-compile hang (the two need different remedies).
+    _mark("compile (paxos2 engine)")
     tpu_p2 = paxos_model(2).checker().spawn_tpu(sync=True, capacity=1 << 18)
     _mark("paxos2 parity done")
     if tpu_p2.unique_state_count() != PAXOS2_UNIQUE:
@@ -559,12 +603,29 @@ def tpu_phase() -> dict:
             b = b.target_states(int(target))
         return b.spawn_tpu(sync=True, **caps)
 
+    _mark("compile (paxos3 engine)")
+    t_warm = time.monotonic()
     spawn3()  # warm-up (compile)
+    phases["paxos3_warmup_secs"] = round(time.monotonic() - t_warm, 3)
     _mark("paxos3 warm-up done")
     tpu_p3, dt = timed(spawn3)
+    phases["paxos3_run_secs"] = round(dt, 3)
     _mark("paxos3 timed run done")
     if tpu_p3.flight_recorder is not None:
         out["tpu_paxos3_telemetry"] = tpu_p3.flight_recorder.summary()
+        # the per-stage attribution (init-compile / rung-compile /
+        # device-step / growth / host) of the TIMED run — the numbers the
+        # >=1M states/s chase is driven by (docs/perf.md)
+        stages = tpu_p3.flight_recorder.stages()
+        if stages:
+            out["tpu_paxos3_stages"] = stages
+        compiles = tpu_p3.flight_recorder.records("compile")
+        if compiles:
+            out["tpu_paxos3_compile_events"] = [
+                {k: c.get(k) for k in
+                 ("rung", "source", "cache_hit", "duration", "cap")}
+                for c in compiles
+            ]
     out["tpu_paxos3_states_per_sec"] = round(tpu_p3.state_count() / dt, 1)
     out["tpu_paxos3_states"] = tpu_p3.state_count()
     out["tpu_paxos3_unique"] = tpu_p3.unique_state_count()
@@ -583,6 +644,7 @@ def tpu_phase() -> dict:
     # AS WRITTEN (it is too small to rate-limit a TPU — ~2k unique states
     # finish in one engine call — so the rate mostly measures fixed per-run
     # overhead; 2pc7/2pc10 below give the throughput-representative number)
+    _mark("compile (2pc5 engine)")
     tpu_t5 = TwoPhaseSys(5).checker().spawn_tpu(sync=True, capacity=1 << 17)
     _mark("2pc5 parity done")
     if tpu_t5.unique_state_count() != TPC5_UNIQUE:
@@ -813,6 +875,27 @@ class Probe:
             }
 
 
+def _kill_reason(
+    stuck_init: bool, last_stage: str, init_s: float, timeout_s: float
+) -> str:
+    """Classify a watchdog kill for the headline ``error`` field: a child
+    that never got past backend init (the dead-tunnel signature), one
+    that died inside a compile/warm-up window (the ``compile ...`` stage
+    marks — each spans the engine compile AND the warm-up run it fuses
+    with, so the message says so), and everything else are three
+    different problems — the first needs the tunnel fixed, the second
+    points at cold compiles (the persistent compile cache, docs/perf.md)
+    or a wedged warm-up, the third is a genuine run-budget miss."""
+    if stuck_init:
+        return f"stuck in backend init for {init_s:.0f}s"
+    if last_stage.startswith("compile"):
+        return (
+            f"stuck in engine compile/warm-up after {timeout_s:.0f}s "
+            f"(stage: {last_stage})"
+        )
+    return f"timed out after {timeout_s:.0f}s (stage: {last_stage or 'unknown'})"
+
+
 def run_tpu_attempt(timeout_s: float, init_s: float = None) -> dict:
     """Run ``tpu_phase`` in a child; a backend hang cannot take down the
     parent's JSON lines.  Child stderr goes to a temp file (not a pipe) so
@@ -907,10 +990,8 @@ def _run_tpu_child(
                     )
                     stuck_init = not init_passed and now - t0 > init_s
                 if now > deadline or stuck_init:
-                    why = (
-                        f"stuck in backend init for {init_s:.0f}s"
-                        if stuck_init
-                        else f"timed out after {timeout_s:.0f}s"
+                    why = _kill_reason(
+                        stuck_init, last_stage(), init_s, timeout_s
                     )
                     _term_then_kill(proc)
                     res = _salvage(stage_path)
@@ -997,7 +1078,83 @@ def run_tpu_with_budget(budget_s: float, probe: Probe) -> dict:
     return merged
 
 
+def _ab_run_one(rm: int, capacity: int, target) -> dict:
+    """One A/B leg: a warm (compile pre-paid) timed 2pc run at the FIXED
+    table capacity, with telemetry so the verdict carries occupancy and
+    the per-stage breakdown."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    m = TwoPhaseSys(rm)
+    caps = dict(sync=True, capacity=capacity, queue_capacity=capacity >> 2,
+                batch=2048, steps_per_call=256, cand=1 << 15)
+
+    def spawn():
+        b = m.checker().telemetry(capacity=2048, occupancy_every=8)
+        if target:
+            b = b.target_states(int(target))
+        return b.spawn_tpu(**caps)
+
+    spawn()  # warm-up: same model instance, so the engine cache carries
+    c, dt = timed(spawn)
+    rec = c.flight_recorder
+    summ = rec.summary() if rec is not None else {}
+    return {
+        "states_per_sec": round(c.state_count() / dt, 1),
+        "states": c.state_count(),
+        "unique": c.unique_state_count(),
+        "sec": round(dt, 3),
+        "occupancy_last": summ.get("occupancy_last"),
+        "stages": rec.stages() if rec is not None else None,
+        "growth_events": summ.get("growth_events"),
+    }
+
+
+def ab_table(run_one=None) -> int:
+    """``bench.py --ab-table``: the 2pc7-vs-2pc10 same-table-size A/B
+    (ROADMAP re-measure item).  Round 4 measured 2pc(7) at 1.45M states/s
+    vs same-table-size 2pc(10) at 866k/s; the bucket-mix fix (PR 3)
+    removed the prime suspect, and this mode re-measures the spread the
+    day the tunnel opens.  Both configs run at the SAME fixed capacity
+    (``BENCH_AB_CAPACITY``, default 2^23 slots) and the same insert volume
+    (2pc10 targets 2pc7's unique count, or both take ``BENCH_AB_TARGET``),
+    so any residual rate spread is table behavior, not volume.  Emits one
+    compact JSON line; full legs go to the details side file."""
+    cap = int(os.environ.get("BENCH_AB_CAPACITY", str(1 << 23)))
+    target = os.environ.get("BENCH_AB_TARGET", "")
+    run_one = run_one or (lambda rm, t: _ab_run_one(rm, cap, t))
+    out: dict = {"metric": "2pc7 vs 2pc10 same-table-size A/B",
+                 "capacity": cap}
+    try:
+        r7 = run_one(7, int(target) if target else None)
+        # same insert volume for the bigger config: 2pc7's unique count
+        r10 = run_one(10, int(target) if target else r7["unique"])
+    except Exception as e:  # noqa: BLE001 - one JSON line either way
+        out["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(out), flush=True)
+        return 1
+    out["tpu_2pc7_states_per_sec"] = r7["states_per_sec"]
+    out["tpu_2pc7_unique"] = r7["unique"]
+    out["tpu_2pc10_states_per_sec"] = r10["states_per_sec"]
+    out["tpu_2pc10_unique"] = r10["unique"]
+    if r10["states_per_sec"]:
+        out["ratio_7_over_10"] = round(
+            r7["states_per_sec"] / r10["states_per_sec"], 3
+        )
+    full = {**out, "tpu_2pc7_ab": r7, "tpu_2pc10_ab": r10}
+    base, ext = os.path.splitext(DETAILS_PATH)
+    side = f"{base}-ab-table{ext or '.json'}"
+    try:
+        with open(side, "w") as f:
+            json.dump(full, f, indent=1)
+    except OSError as e:
+        sys.stderr.write(f"bench: ab-table details unwritable: {e}\n")
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def main() -> int:
+    if "--ab-table" in sys.argv:
+        return ab_table()
     if "--tpu-probe" in sys.argv:
         import faulthandler
 

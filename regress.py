@@ -7,6 +7,7 @@ staleness and regressions LOUD:
 
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
+                      [--stages]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -111,10 +112,40 @@ def sanitizer_verdict(fleet=None) -> dict:
     }
 
 
+def stage_verdict(run: dict, baseline: dict) -> dict:
+    """``--stages``: the per-stage attribution section (docs/perf.md).
+
+    A FRESH run must carry a well-formed ``tpu_paxos3_stages`` breakdown
+    (every value a non-negative number) — a perf round without attribution
+    is exactly the blind spot the attribution work closed.  The baseline's
+    breakdown is attached for comparison when present but NEVER gates:
+    stored baselines predating the attribution round (or measured on
+    different hardware) have no stages, and stale numbers must not trip a
+    fresh run (the same principle as the throughput gate's
+    present-in-BOTH rule)."""
+    rstages = run.get("tpu_paxos3_stages")
+    out: dict = {"present": bool(rstages)}
+    if not rstages:
+        out["ok"] = False
+        out["error"] = "run carries no tpu_paxos3_stages breakdown"
+    else:
+        bad = sorted(
+            k for k, v in rstages.items()
+            if not isinstance(v, (int, float)) or v < 0
+        )
+        out["ok"] = not bad
+        if bad:
+            out["malformed"] = bad
+        out["run"] = rstages
+    out["baseline"] = baseline.get("tpu_paxos3_stages")
+    return out
+
+
 def main(argv=None, fleet=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
+    stages = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -125,6 +156,8 @@ def main(argv=None, fleet=None) -> int:
             allow_stale = True
         elif a == "--sanitize":
             sanitize = True
+        elif a == "--stages":
+            stages = True
         else:
             pos.append(a)
     if pos:
@@ -150,6 +183,12 @@ def main(argv=None, fleet=None) -> int:
     if sanitize and (verdict["fresh"] or allow_stale):
         verdict["sanitizer"] = sanitizer_verdict(fleet=fleet)
         verdict["ok"] = verdict["ok"] and verdict["sanitizer"]["clean"]
+    if stages:
+        verdict["stages"] = stage_verdict(run, baseline)
+        # only a FRESH run is required to carry attribution — a stored/
+        # stale artifact predating the attribution round must not trip
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["stages"]["ok"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -169,6 +208,17 @@ def main(argv=None, fleet=None) -> int:
             "regress: the example fleet FAILS the soundness sanitizer "
             "(JX2xx; see stdout JSON) — throughput from kernels with "
             "out-of-range indexing is not a valid measurement\n"
+        )
+        return 1
+    if (
+        "stages" in verdict
+        and verdict["fresh"]
+        and not verdict["stages"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: fresh run carries no (or malformed) per-stage "
+            "attribution (tpu_paxos3_stages) — an unattributed perf "
+            "number cannot drive the >=1M states/s chase (docs/perf.md)\n"
         )
         return 1
     return 0

@@ -36,6 +36,10 @@ class CheckerBuilder:
         self._audit_skip = False
         self.telemetry_opts: Optional[dict] = None
         self.checked_mode = False
+        # wavefront-throughput knobs (docs/perf.md); None = env default
+        self.prewarm_mode: Optional[bool] = None
+        self.prededup_mode: Optional[bool] = None
+        self.compile_cache_dir: Optional[str] = None
 
     # -- configuration -------------------------------------------------------
 
@@ -111,6 +115,49 @@ class CheckerBuilder:
             "profile_steps": profile_steps,
             "profile_dir": profile_dir,
         }
+        return self
+
+    def prewarm(self, enabled: bool = True) -> "CheckerBuilder":
+        """Growth-stall elision for the single-device wavefront engine
+        (``docs/perf.md``): the growth ladder's next capacity rungs are
+        compiled AHEAD OF TIME on a background thread
+        (``jax.jit(...).lower().compile()``), so a growth boundary swaps in
+        a ready executable instead of blocking the run on a cold engine
+        compile.  Wrong predictions cost one wasted background compile and
+        nothing else; the consumed/wasted split and the per-boundary wait
+        are recorded in the flight recorder (``compile`` events:
+        ``source="prewarm"``, ``duration``).  Default off (env override
+        ``STATERIGHT_TPU_PREWARM=1``); search semantics are untouched —
+        the prewarmed executable is the SAME program, compiled earlier."""
+        self.prewarm_mode = bool(enabled)
+        return self
+
+    def prededup(self, enabled: bool = True) -> "CheckerBuilder":
+        """Device-side intra-window candidate pre-dedup
+        (``ops/buckets.window_unique``; ``docs/perf.md``): duplicate
+        fingerprints inside one expansion window are masked to EMPTY before
+        the visited-set insert, shrinking the insert pipeline's effective
+        width to the window's unique count (the BLEST move: dedup the
+        frontier BEFORE the expensive global-memory phase).  Equivalence
+        contract, pinned by tests: unique/state counts, discovery traces,
+        and the inserted table are bit-identical with the flag on or off —
+        the filter keeps exactly the lane ``bucket_insert``'s stable sort
+        would have kept.  Default off (env override
+        ``STATERIGHT_TPU_PREDEDUP=1``); with the flag off the step jaxpr
+        is unchanged (same contract as telemetry/checked)."""
+        self.prededup_mode = bool(enabled)
+        return self
+
+    def compile_cache(self, path: str) -> "CheckerBuilder":
+        """Opt into JAX's persistent compilation cache at ``path``
+        (``docs/perf.md``): engine executables are cached on disk keyed on
+        their HLO, so repeated CLI/bench/regress invocations skip XLA
+        engine compiles entirely (including every growth rung a previous
+        run already visited).  Applies process-wide on first engine spawn
+        — the cache dir is a global JAX setting.  Env equivalent:
+        ``STATERIGHT_TPU_COMPILE_CACHE=DIR``.  Per-rung hits are recorded
+        in the flight recorder's ``compile`` events (``cache_hit``)."""
+        self.compile_cache_dir = str(path)
         return self
 
     def checked(self, enabled: bool = True) -> "CheckerBuilder":

@@ -68,6 +68,9 @@ def run_cli(
     else:
         print("USAGE:")
         print(usage)
+        if check_tpu is not None:
+            print("  device verbs also take --checked, --prewarm, "
+                  "--prededup, --compile-cache=DIR (docs/perf.md)")
         if audit is not None:
             print("  <example> audit    # static preflight audit "
                   "(docs/analysis.md)")
@@ -88,6 +91,38 @@ def pop_checked(rest: list) -> tuple:
     while "--checked" in rest:
         rest.remove("--checked")
     return checked, rest
+
+
+def pop_perf(rest: list) -> tuple:
+    """Strip the wavefront-throughput flags (``docs/perf.md``) from a device
+    verb's arguments: ``(cfg, rest)`` where ``cfg`` holds ``prewarm``/
+    ``prededup`` (bool) and ``compile_cache`` (dir or None).  Apply with
+    :func:`apply_perf`.  Env knobs (``STATERIGHT_TPU_PREWARM`` etc.) still
+    work without the flags — these exist so one-off CLI runs can A/B."""
+    rest = list(rest)
+    cfg = {"prewarm": False, "prededup": False, "compile_cache": None}
+    kept = []
+    for a in rest:
+        if a == "--prewarm":
+            cfg["prewarm"] = True
+        elif a == "--prededup":
+            cfg["prededup"] = True
+        elif a.startswith("--compile-cache="):
+            cfg["compile_cache"] = a[len("--compile-cache="):]
+        else:
+            kept.append(a)
+    return cfg, kept
+
+
+def apply_perf(builder, cfg: dict):
+    """Apply a :func:`pop_perf` config onto a ``CheckerBuilder``."""
+    if cfg.get("prewarm"):
+        builder = builder.prewarm()
+    if cfg.get("prededup"):
+        builder = builder.prededup()
+    if cfg.get("compile_cache"):
+        builder = builder.compile_cache(cfg["compile_cache"])
+    return builder
 
 
 def default_threads() -> int:

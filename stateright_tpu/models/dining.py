@@ -27,9 +27,12 @@ from ..actor.device_props import exists_actor, forall_actors
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
     make_sanitize_cmd,
+    pop_checked,
+    pop_perf,
     run_cli,
 )
 
@@ -160,6 +163,8 @@ def main(argv=None) -> None:
             print(trace)
 
     def check_tpu(rest):
+        checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         n = parse(rest)
         print(
             f"Model checking {n} dining philosophers on the device "
@@ -169,7 +174,7 @@ def main(argv=None) -> None:
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return
-        m.checker().spawn_tpu().report()
+        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
 
     def check_auto(rest):
         n = parse(rest)

@@ -31,9 +31,12 @@ from ..actor.register import (
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
     make_sanitize_cmd,
+    pop_checked,
+    pop_perf,
     run_cli,
 )
 
@@ -284,6 +287,8 @@ def main(argv=None):
         ).spawn_bfs().report()
 
     def check_tpu(rest):
+        checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         client_count = int(rest[0]) if rest else 2
         network = (
             Network.from_name(rest[1])
@@ -302,7 +307,7 @@ def main(argv=None):
                 "`check` (CPU) or a non-duplicating/ordered network"
             )
             return
-        m.checker().spawn_tpu().report()
+        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
 
     def check_auto(rest):
         client_count = int(rest[0]) if rest else 2

@@ -37,11 +37,13 @@ from ..actor.register import (
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
     make_profile_cmd,
     make_sanitize_cmd,
     pop_checked,
+    pop_perf,
     run_cli,
 )
 
@@ -304,6 +306,7 @@ def main(argv=None):
 
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         client_count = int(rest[0]) if rest else 2
         target = int(rest[1]) if len(rest) > 1 else None
         print(
@@ -317,7 +320,7 @@ def main(argv=None):
                 "this configuration has no device twin; use `check` (CPU)"
             )
             return
-        b = m.checker().checked(checked)
+        b = apply_perf(m.checker().checked(checked), perf)
         if target:
             b = b.target_states(target)
         b.spawn_tpu().report()

@@ -35,9 +35,12 @@ from ..actor.device_props import exists_actor, forall_actor_pairs
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
     make_sanitize_cmd,
+    pop_checked,
+    pop_perf,
     run_cli,
 )
 
@@ -237,6 +240,8 @@ def main(argv=None) -> None:
         ).spawn_dfs().report()
 
     def check_sym_tpu(rest):
+        checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         n, network = parse(rest)
         print(
             f"Model checking Raft leader election with {n} servers on the "
@@ -246,9 +251,13 @@ def main(argv=None) -> None:
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check-sym`")
             return
-        m.checker().symmetry().spawn_tpu().report()
+        apply_perf(
+            m.checker().checked(checked).symmetry(), perf
+        ).spawn_tpu().report()
 
     def check_tpu(rest):
+        checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         n, network = parse(rest)
         print(
             f"Model checking Raft leader election with {n} servers on the "
@@ -258,7 +267,7 @@ def main(argv=None) -> None:
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return
-        m.checker().spawn_tpu().report()
+        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
 
     def check_auto(rest):
         n, network = parse(rest)

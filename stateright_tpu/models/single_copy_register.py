@@ -27,9 +27,12 @@ from ..actor.register import (
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
     make_sanitize_cmd,
+    pop_checked,
+    pop_perf,
     run_cli,
 )
 
@@ -113,6 +116,8 @@ def main(argv=None):
         ).spawn_dfs().report()
 
     def check_tpu(rest):
+        checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         client_count = int(rest[0]) if rest else 2
         network = (
             Network.from_name(rest[1])
@@ -127,7 +132,7 @@ def main(argv=None):
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return
-        m.checker().spawn_tpu().report()
+        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
 
     def check_auto(rest):
         client_count = int(rest[0]) if rest else 2

@@ -25,11 +25,13 @@ from .. import Model, Property
 from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
 from ..symmetry import RewritePlan
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
     make_profile_cmd,
     make_sanitize_cmd,
     pop_checked,
+    pop_perf,
     run_cli,
 )
 
@@ -395,22 +397,28 @@ def main(argv=None):
 
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         rm_count = int(rest[0]) if rest else 2
         print(
             f"Checking two phase commit with {rm_count} RMs on TPU"
             + (" (checked mode)." if checked else ".")
         )
-        TwoPhaseSys(rm_count).checker().checked(checked).spawn_tpu().report()
+        apply_perf(
+            TwoPhaseSys(rm_count).checker().checked(checked), perf
+        ).spawn_tpu().report()
 
     def check_sym_tpu(rest):
         checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
         rm_count = int(rest[0]) if rest else 2
         print(
             f"Checking two phase commit with {rm_count} RMs on TPU "
             "using symmetry reduction"
             + (" (checked mode)." if checked else ".")
         )
-        TwoPhaseSys(rm_count).checker().checked(checked).symmetry(
+        apply_perf(
+            TwoPhaseSys(rm_count).checker().checked(checked).symmetry(),
+            perf,
         ).spawn_tpu().report()
 
     def check_auto(rest):

@@ -36,6 +36,15 @@ def load() -> Optional[object]:
     if str(_DIR) not in sys.path:
         sys.path.insert(0, str(_DIR))
     try:
+        # freshness first: a stale committed/previous build would otherwise
+        # import fine but miss newer entry points (e.g. bfs_run), and an
+        # already-imported extension module cannot be reloaded in-process
+        from .build import build
+
+        build()
+    except Exception:  # noqa: BLE001 - no compiler: try whatever exists
+        pass
+    try:
         _module = importlib.import_module("_stateright_native")
         return _module
     except ImportError:

@@ -1,0 +1,113 @@
+"""Compiled-CPU wavefront baseline (ROADMAP open item; docs/perf.md).
+
+``compiled_cpu_bfs(model)`` runs a single-core BFS over the model's packed
+tensor rows: successor generation and property evaluation go through the
+SAME XLA-CPU-jitted kernels the device engine uses (``step_rows`` +
+``property_masks`` on the tensor twin), while the visited set and FIFO
+queue — the engine's bucketized table and device queue — run natively in
+C++ (``bfs.cpp``).  This is the honest denominator for the bench's
+``vs_baseline``: a pure-Python BFS flatters the device engine by however
+slow CPython's per-state loop is, which says nothing about the hardware.
+
+Returns None when the native module is unavailable (no compiler) or the
+model has no tensor twin — callers fall back to the Python baseline and
+disclose the substitution (``bench.py``'s ``cpu_baseline_engine``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from . import load
+
+
+def _tensor_of(model):
+    cached = getattr(model, "_tensor_cached", None)
+    try:
+        return cached() if cached is not None else (
+            getattr(model, "tensor_model", lambda: None)()
+        )
+    except Exception:  # noqa: BLE001 - CompileError etc: no twin, no baseline
+        return None
+
+
+def compiled_cpu_bfs(
+    model, target: Optional[int] = None, batch: int = 1024
+) -> Optional[dict]:
+    """Single-core compiled BFS over ``model``'s tensor twin.
+
+    ``target`` stops at a clean batch boundary once that many unique states
+    are visited (None = exhaust), mirroring the engines' ``target_states``
+    semantics so prefix rates are comparable.  Returns ``{states, unique,
+    wavefronts, secs, states_per_sec}`` or None when native/twin support
+    is missing.
+
+    Work parity per batch: the expansion callback evaluates the property
+    masks too (the engines do, per popped batch), and applies the same
+    boundary filter to successors, so counts match the device engines'
+    ``scount``/``unique`` conventions exactly (pinned by tests).
+    """
+    mod = load()
+    if mod is None or not hasattr(mod, "bfs_run"):
+        return None
+    tensor = _tensor_of(model)
+    if tensor is None:
+        return None
+
+    import jax
+    import jax.numpy as jnp
+
+    width, arity = tensor.width, tensor.max_actions
+    boundary_fn = (
+        tensor.boundary_rows if getattr(tensor, "has_boundary", False)
+        else None
+    )
+
+    @jax.jit
+    def kernel(rows):
+        succ, valid = tensor.step_rows(rows)
+        if boundary_fn is not None:
+            valid = valid & boundary_fn(succ)
+        masks = tensor.property_masks(rows)  # evaluated for work parity
+        return succ, valid, jnp.any(masks)
+
+    init_rows = np.ascontiguousarray(
+        np.asarray(tensor.init_rows(), dtype=np.uint64)
+    )
+    n_init = init_rows.shape[0]
+    pad_row = init_rows[0] if n_init else np.zeros((width,), np.uint64)
+
+    def expand(buf: bytes, k: int):
+        rows = np.frombuffer(buf, dtype=np.uint64).reshape(k, width)
+        if k < batch:  # fixed batch shape: one compile for the whole run
+            rows = np.concatenate(
+                [rows, np.broadcast_to(pad_row, (batch - k, width))]
+            )
+        succ, valid, _ = kernel(jnp.asarray(rows))
+        return (
+            np.ascontiguousarray(np.asarray(succ, dtype=np.uint64)),
+            np.ascontiguousarray(np.asarray(valid, dtype=np.bool_)),
+        )
+
+    # warm-up: pay the kernel's one-time XLA compile outside the timed
+    # window (the device bench does the same — the rate is a steady-state
+    # throughput claim, not a cold-start claim)
+    kernel(
+        jnp.asarray(np.broadcast_to(pad_row, (batch, width)))
+    )[1].block_until_ready()
+
+    t0 = time.monotonic()
+    states, unique, wavefronts = mod.bfs_run(
+        expand, init_rows, n_init, width, arity, batch, int(target or 0)
+    )
+    secs = max(time.monotonic() - t0, 1e-9)
+    return {
+        "states": int(states),
+        "unique": int(unique),
+        "wavefronts": int(wavefronts),
+        "secs": round(secs, 4),
+        "states_per_sec": round(states / secs, 1),
+    }

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 import sysconfig
@@ -10,14 +11,31 @@ from pathlib import Path
 _DIR = Path(__file__).parent
 
 
+SOURCES = ("linearize.cpp", "bfs.cpp")
+
+
+def is_stale(out: Path) -> bool:
+    """True when the built module is missing or older than any source."""
+    if not out.exists():
+        return True
+    newest = max((_DIR / s).stat().st_mtime for s in SOURCES)
+    return out.stat().st_mtime < newest
+
+
 def build() -> Path:
-    """Compile linearize.cpp into ``_stateright_native`` next to it."""
+    """Compile the native sources into ``_stateright_native`` next to them
+    (one module: linearize.cpp holds the module init and method table,
+    bfs.cpp the wavefront baseline)."""
     ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     out = _DIR / f"_stateright_native{ext}"
-    src = _DIR / "linearize.cpp"
-    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+    if not is_stale(out):
         return out
     include = sysconfig.get_path("include")
+    # compile to a private temp path, then atomically rename: load() now
+    # triggers builds implicitly, so concurrent processes (bench parent +
+    # its probe/tpu children, parallel test workers) must never import a
+    # half-written shared object
+    tmp = out.with_name(f".{out.name}.build-{os.getpid()}")
     cmd = [
         "g++",
         "-O2",
@@ -25,11 +43,16 @@ def build() -> Path:
         "-shared",
         "-fPIC",
         f"-I{include}",
-        str(src),
+        *(str(_DIR / s) for s in SOURCES),
         "-o",
-        str(out),
+        str(tmp),
     ]
-    subprocess.run(cmd, check=True, capture_output=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, out)
+    finally:
+        if tmp.exists():
+            tmp.unlink()
     return out
 
 

@@ -27,6 +27,10 @@
 #include <cstdint>
 #include <vector>
 
+/* bfs.cpp: the single-core compiled-CPU wavefront baseline (both sources
+ * compile into this one module; see native/build.py). */
+extern "C" PyObject* stateright_native_bfs_run(PyObject*, PyObject*);
+
 namespace {
 
 constexpr int KIND_WRITE = 0;
@@ -193,6 +197,10 @@ PyMethodDef methods[] = {
     {"serialize_register", serialize_register, METH_VARARGS,
      "Exhaustive register-history serialization search. Returns True iff a "
      "legal total order exists."},
+    {"bfs_run", stateright_native_bfs_run, METH_VARARGS,
+     "Single-core wavefront BFS over packed u64 rows (bfs.cpp): native "
+     "visited set + FIFO queue around a batch-expansion callback. Returns "
+     "(states, unique, wavefronts)."},
     {nullptr, nullptr, 0, nullptr},
 };
 

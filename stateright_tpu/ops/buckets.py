@@ -70,6 +70,38 @@ def bucket_key(fps: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(fps == EMPTY, EMPTY, k)
 
 
+def window_unique(fps: jnp.ndarray) -> jnp.ndarray:
+    """Intra-window pre-dedup: mask duplicate fingerprints to EMPTY, keeping
+    the FIRST occurrence (lowest lane index) of each.
+
+    ``bucket_insert`` already dedups within its window (the first-occurrence
+    mask over the sorted candidates), so this is purely a *traffic* reducer:
+    engine candidate windows are mostly duplicates of each other (BLEST-style
+    frontier duplication — siblings regenerate the same successors), and
+    every duplicate lane left valid pays full price through the compaction
+    budget, the membership gathers, and the rank pipeline.  EMPTYing them
+    here shrinks the insert loop's EFFECTIVE window to the unique count.
+
+    Exactness contract (pinned by tests): because the kept lane is the first
+    occurrence by original index — the same lane ``bucket_insert``'s stable
+    sort would have picked as the survivor, in both table order and
+    generation order — the inserted (fp, payload) set, ``sel`` prefix, and
+    ``n_new`` are bit-identical with or without the filter.  Only
+    ``cand_overflow`` pressure changes (it can only drop).  EMPTY lanes pass
+    through unchanged.  One extra sort + bool scatter per window; on TPU the
+    sort is cheap next to the table gathers it avoids.
+    """
+    m = fps.shape[0]
+    order = jnp.argsort(fps)  # stable: ties keep original index order
+    sfp = fps[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sfp[1:] != sfp[:-1]])
+    # (fps != fps) is an all-False array DERIVED from the input, so the
+    # mask stays mesh-varying inside shard_map (a zeros() literal would be
+    # replicated-typed; cf. the membership-loop carries in bucket_insert)
+    keep = (fps != fps).at[order].set(first)
+    return jnp.where(keep, fps, EMPTY)
+
+
 def bucket_of(fps, nbuckets: int) -> np.ndarray:
     """Host-side bucket derivation (numpy): the bucket ``bucket_insert``
     and ``host_bucket_rehash`` place ``fps`` in for an ``nbuckets``-bucket

@@ -88,6 +88,35 @@ class WavefrontChecker(Checker):
         self._target = options.target_state_count
         self._verify_fingerprint_bridge()
 
+        # wavefront-throughput knobs (docs/perf.md): builder flags win,
+        # env knobs otherwise.  Pre-dedup is a per-engine jaxpr flag (both
+        # engines); prewarm is single-device only (the sharded engine's
+        # growth rebuilds are whole-mesh shard_maps — background-compiling
+        # them is future work); the persistent compile cache is a global
+        # JAX setting enabled here once a dir is configured.
+        from .prewarm import (
+            ENV_PREDEDUP,
+            ENV_PREWARM,
+            enable_persistent_compile_cache,
+            resolve_flag,
+        )
+
+        self._prededup = resolve_flag(
+            getattr(options, "prededup_mode", None), ENV_PREDEDUP
+        )
+        self._prewarm = resolve_flag(
+            getattr(options, "prewarm_mode", None), ENV_PREWARM
+        )
+        self._compile_cache_dir = enable_persistent_compile_cache(
+            getattr(options, "compile_cache_dir", None)
+        )
+        self._prewarmer = None
+        self._pending_compile_rec = None
+        if self._prewarm and self._engine_tag == "single":
+            from .prewarm import EnginePrewarmer
+
+            self._prewarmer = EnginePrewarmer()
+
         # flight recorder (stateright_tpu/telemetry/): engines record one
         # "step" record per host sync from values the loop already pulls —
         # telemetry never adds device ops (docs/telemetry.md overhead
@@ -180,6 +209,16 @@ class WavefrontChecker(Checker):
                 "resume snapshot was taken from a different model "
                 "(init fingerprints / tensor signature disagree)"
             )
+
+    def _stage(self, name: str, secs: float) -> None:
+        """Accumulate one per-stage wall-time counter (docs/perf.md): the
+        breakdown the recorder's ``stages()`` view is derived from.  Both
+        engines call this from their host loops only — attribution adds
+        zero device ops (same contract as the rest of telemetry).  Zero
+        values still record: a fully-warm run reports ``compile_secs: 0``
+        rather than omitting the field (bench/regress key on presence)."""
+        if self.flight_recorder is not None and secs >= 0:
+            self.flight_recorder.add(f"stage_{name}_secs", secs)
 
     def _telemetry_occupancy(self, table_fp, *, at: str,
                              transferred: bool = False) -> None:

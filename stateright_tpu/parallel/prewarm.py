@@ -1,0 +1,435 @@
+"""Engine prewarm + persistent compile cache + compile-time attribution.
+
+Three pieces of the wavefront throughput round (docs/perf.md), all about
+the same unattributed cost center — XLA engine compiles:
+
+ - :class:`EnginePrewarmer` — a single background worker thread that
+   compiles the growth ladder's NEXT capacity rungs ahead of time
+   (``jax.jit(...).lower(avals).compile()``), so a growth boundary swaps
+   in a ready executable instead of blocking the run on a cold compile.
+   The predicted rungs are cheap to enumerate (capacities only ever
+   double; see ``TpuChecker._schedule_prewarm``), and a wrong prediction
+   costs one wasted background compile, never correctness: the prewarmed
+   executable is the SAME program, compiled earlier.
+
+ - :func:`enable_persistent_compile_cache` — opt-in wiring of JAX's
+   persistent compilation cache (``jax_compilation_cache_dir``), so
+   repeated CLI/bench/regress invocations skip engine compiles entirely.
+   Thresholds are zeroed: engine compiles are seconds-long on hardware,
+   but the default min-compile-time gate would skip caching the small
+   helper programs whose re-trace still costs host time.
+
+ - :class:`CompileWatch` — compile-time attribution via JAX's monitoring
+   events (``/jax/core/compile/backend_compile_duration`` and the
+   compilation-cache hit/miss events).  The engines' run loops snapshot
+   it around device calls to split "device step" from "XLA compile" wall
+   time without adding any ops to the compiled programs.  Counters are
+   PER-THREAD (jax fires the events on the compiling thread), so the run
+   loop's watch never absorbs the prewarm worker's background compiles —
+   each watcher sees exactly its own.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+# -- compile-event accounting (jax monitoring) --------------------------------
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+# PER-THREAD accumulators: jax's monitoring events fire synchronously on
+# the thread performing the compile, so thread-local counters give each
+# watcher exactly its own compiles — the run loop's watch never sees the
+# prewarm worker's background compiles and vice versa (a process-global
+# counter attributed whoever compiled anywhere to whoever was watching).
+_tls = threading.local()
+
+_COMPILE_DURATION_EVENTS = (
+    "/jax/core/compile/backend_compile_duration",
+    "/jax/compilation_cache/cache_retrieval_time_sec",
+)
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _tls_counts() -> dict:
+    counts = getattr(_tls, "counts", None)
+    if counts is None:
+        counts = {
+            "backend_compile_secs": 0.0,  # backend compiles + retrievals
+            "persistent_cache_hits": 0,
+            "persistent_cache_misses": 0,
+        }
+        _tls.counts = counts
+    return counts
+
+
+def _install_listener() -> bool:
+    """Register the jax monitoring listeners once; False when this jax
+    build has no monitoring surface (attribution then reads 0)."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return True
+        try:
+            from jax._src import monitoring
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            return False
+
+        def on_event(event, **kw):
+            if event == _HIT_EVENT:
+                _tls_counts()["persistent_cache_hits"] += 1
+            elif event == _MISS_EVENT:
+                _tls_counts()["persistent_cache_misses"] += 1
+
+        def on_duration(event, duration, **kw):
+            if event in _COMPILE_DURATION_EVENTS:
+                _tls_counts()["backend_compile_secs"] += max(
+                    float(duration), 0.0
+                )
+
+        try:
+            monitoring.register_event_listener(on_event)
+            monitoring.register_event_duration_secs_listener(on_duration)
+        except Exception:  # noqa: BLE001
+            return False
+        _listener_installed = True
+        return True
+
+
+def compile_counters() -> dict:
+    """Snapshot of the CALLING THREAD's compile accounting (installs the
+    monitoring listener on first call)."""
+    _install_listener()
+    return dict(_tls_counts())
+
+
+class CompileWatch:
+    """Delta view over :func:`compile_counters`: ``start()`` then
+    ``delta()`` yields the compile seconds and persistent-cache hits the
+    CURRENT THREAD performed in between (see module docstring)."""
+
+    def __init__(self):
+        self._base = compile_counters()
+
+    def start(self) -> "CompileWatch":
+        self._base = compile_counters()
+        return self
+
+    def delta(self) -> dict:
+        now = compile_counters()
+        return {
+            "compile_secs": round(
+                now["backend_compile_secs"] - self._base["backend_compile_secs"],
+                6,
+            ),
+            "persistent_hits": (
+                now["persistent_cache_hits"]
+                - self._base["persistent_cache_hits"]
+            ),
+            "persistent_misses": (
+                now["persistent_cache_misses"]
+                - self._base["persistent_cache_misses"]
+            ),
+        }
+
+
+# -- persistent compilation cache ---------------------------------------------
+
+ENV_COMPILE_CACHE = "STATERIGHT_TPU_COMPILE_CACHE"
+ENV_PREWARM = "STATERIGHT_TPU_PREWARM"
+ENV_PREDEDUP = "STATERIGHT_TPU_PREDEDUP"
+
+_cache_lock = threading.Lock()
+_cache_dir: Optional[str] = None
+
+
+def enable_persistent_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``path`` (default: the
+    ``STATERIGHT_TPU_COMPILE_CACHE`` env var; no-op returning None when
+    neither is set).  Idempotent; re-pointing at a different dir is
+    honored (last caller wins — it is one global JAX setting).  Also zeroes
+    the cache's size/compile-time admission thresholds so every engine
+    program is cached, and installs the hit/miss listener so the flight
+    recorder can tell a disk hit from a fresh compile."""
+    global _cache_dir
+    path = path or os.environ.get(ENV_COMPILE_CACHE) or None
+    if not path:
+        return None
+    with _cache_lock:
+        if _cache_dir == path:
+            return path
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        _reset_jax_cache_decision()
+        _cache_dir = path
+    _install_listener()
+    return path
+
+
+def _reset_jax_cache_decision() -> None:
+    """jax caches its is-the-cache-used decision at the FIRST compile of
+    the process (``compilation_cache._cache_checked``), so enabling the
+    dir after any compile (audit preflight, another model) would be
+    silently ignored without this reset.  Private-API touch, guarded: on
+    a jax without it the cache still works when the dir is set before the
+    first compile."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def disable_persistent_compile_cache() -> None:
+    """Undo :func:`enable_persistent_compile_cache` (tests restore global
+    state; a long-lived process keeps the cache on once enabled)."""
+    global _cache_dir
+    with _cache_lock:
+        if _cache_dir is None:
+            return
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache_decision()
+        _cache_dir = None
+
+
+def donation_supported() -> bool:
+    """Whether buffer donation is real on the default backend.  The CPU
+    backend ignores ``donate_argnums`` at execution time (jax warns and
+    copies), BUT jax 0.4.x's persistent-compilation-cache deserialization
+    path still applies the donation metadata to a retrieved executable —
+    which then reads input buffers jax has already marked deleted and
+    returns garbage (reproduced on the wavefront engine: correct first
+    run, corrupted counters on every cache-served run; docs/perf.md).
+    The engines therefore request donation only where it actually
+    exists."""
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:  # noqa: BLE001 - no backend: donation moot
+        return False
+
+
+def resolve_flag(mode: Optional[bool], env: str) -> bool:
+    """Builder-flag resolution shared by the engines: an explicit builder
+    setting wins; otherwise the env knob (``=1``) decides."""
+    if mode is not None:
+        return bool(mode)
+    return os.environ.get(env, "") == "1"
+
+
+# -- ahead-of-time engine prewarm ---------------------------------------------
+
+PREWARM_THREAD_NAME = "stateright-prewarm"
+
+# Interpreter-teardown guard: killing a daemon thread in the middle of an
+# XLA compile aborts the process ("terminate called without an active
+# exception"), so an atexit hook drops every queued job and waits out the
+# in-flight one before Python starts tearing down C++ state.
+_live_prewarmers: "weakref.WeakSet" = None  # type: ignore[assignment]
+_atexit_lock = threading.Lock()
+
+
+def _drain_prewarmers_at_exit() -> None:
+    for p in list(_live_prewarmers or ()):
+        try:
+            p.close()
+            p.wait_idle(120.0)
+        except Exception:  # noqa: BLE001 - exit path must never raise
+            pass
+
+
+def _register_prewarmer(p: "EnginePrewarmer") -> None:
+    global _live_prewarmers
+    with _atexit_lock:
+        if _live_prewarmers is None:
+            import atexit
+            import weakref
+
+            _live_prewarmers = weakref.WeakSet()
+            atexit.register(_drain_prewarmers_at_exit)
+        _live_prewarmers.add(p)
+
+
+class _Job:
+    __slots__ = ("key", "build", "done", "result", "error", "compile_secs",
+                 "persistent_hit", "started_t", "finished_t")
+
+    def __init__(self, key, build):
+        self.key = key
+        self.build = build
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.compile_secs = 0.0
+        self.persistent_hit = False
+        self.started_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+
+
+class EnginePrewarmer:
+    """One background worker compiling predicted engine rungs in schedule
+    order.  ``schedule(key, build)`` enqueues ``build()`` (idempotent per
+    key); ``take(key)`` returns ``(result, waited_secs, was_ready)`` for a
+    scheduled key — waiting out an in-flight compile if the boundary
+    arrived first (still strictly better than compiling cold: the compile
+    started earlier) — or ``None`` when the key was never scheduled.
+    ``build`` runs on the worker thread and should return the fully
+    compiled engine; exceptions are captured and re-raised at ``take``
+    (the caller then falls back to its cold path)."""
+
+    def __init__(self, name: str = PREWARM_THREAD_NAME):
+        self._jobs: dict = {}
+        self._queue: list = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._work, name=name, daemon=True
+        )
+        _register_prewarmer(self)
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------------
+
+    def _work(self) -> None:
+        while True:
+            self._wake.wait()
+            with self._lock:
+                if self._closed and not self._queue:
+                    self._idle.set()
+                    return
+                job = self._queue.pop(0) if self._queue else None
+                if not self._queue and not self._closed:
+                    self._wake.clear()
+                if job is not None:
+                    self._idle.clear()
+            if job is None:
+                continue
+            job.started_t = time.monotonic()
+            watch = CompileWatch()
+            try:
+                job.result = job.build()
+            except BaseException as e:  # noqa: BLE001 - surfaced at take()
+                job.error = e
+            d = watch.delta()
+            job.compile_secs = d["compile_secs"]
+            job.persistent_hit = d["persistent_hits"] > 0
+            job.finished_t = time.monotonic()
+            job.done.set()
+            with self._lock:
+                if not self._queue:
+                    self._idle.set()
+
+    # -- caller surface ------------------------------------------------------
+
+    def schedule(self, key, build: Callable[[], object]) -> bool:
+        """Enqueue ``build()`` for ``key`` unless already scheduled;
+        True when a new job was queued."""
+        with self._lock:
+            if self._closed or key in self._jobs:
+                return False
+            job = _Job(key, build)
+            self._jobs[key] = job
+            self._queue.append(job)
+            self._wake.set()
+            return True
+
+    def scheduled(self, key) -> bool:
+        with self._lock:
+            return key in self._jobs
+
+    def ready(self, key) -> bool:
+        """True when ``key``'s background compile has finished (the rung
+        would swap in with ~zero wait)."""
+        with self._lock:
+            job = self._jobs.get(key)
+        return job is not None and job.done.is_set()
+
+    def take(self, key, timeout: Optional[float] = None):
+        """Consume the job for ``key``: ``(result, waited_secs, was_ready)``
+        or None when never scheduled.  A job that is DONE is returned
+        instantly; an IN-FLIGHT compile is waited out (bounded by
+        ``timeout``; the compile started earlier, so waiting beats
+        duplicating it).  A job still sitting in the queue is CANCELLED and
+        None returned — the caller's inline cold build starts immediately
+        instead of queueing behind unrelated background compiles."""
+        with self._lock:
+            job = self._jobs.get(key)
+            if job is None:
+                return None
+            if job in self._queue:  # scheduled but never started: cancel
+                self._queue.remove(job)
+                self._jobs.pop(key, None)
+                return None
+        was_ready = job.done.is_set()
+        t0 = time.monotonic()
+        if not job.done.wait(timeout):
+            return None
+        waited = time.monotonic() - t0
+        with self._lock:
+            self._jobs.pop(key, None)
+        if job.error is not None:
+            raise job.error
+        return job.result, waited, was_ready, job
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._jobs)
+
+    def prune(self, keep) -> int:
+        """Drop jobs whose key is not in ``keep``: queued ones are
+        cancelled outright, finished ones release their executables
+        (their rung can no longer be consumed once capacities moved past
+        it — holding the compiled program is pure memory waste, and a
+        stale queued job would delay the NEXT useful compile on the
+        single worker).  The in-flight job is left alone.  Returns the
+        number of jobs dropped."""
+        keep = set(keep)
+        dropped = 0
+        with self._lock:
+            for job in list(self._queue):
+                if job.key not in keep:
+                    self._queue.remove(job)
+                    self._jobs.pop(job.key, None)
+                    job.error = RuntimeError("prewarm prediction superseded")
+                    job.done.set()
+                    dropped += 1
+            for key, job in list(self._jobs.items()):
+                if key not in keep and job.done.is_set():
+                    self._jobs.pop(key, None)
+                    dropped += 1
+        return dropped
+
+    def close(self) -> None:
+        """Stop accepting work and DROP queued (not yet started) jobs —
+        their predicted rungs will never be consumed once the run is over.
+        The in-flight compile (if any) runs to completion on the worker;
+        :func:`wait_idle` (and the atexit drain) waits it out so the
+        interpreter never tears down under a live XLA compile."""
+        with self._lock:
+            self._closed = True
+            for job in self._queue:
+                job.error = RuntimeError("prewarmer closed")
+                job.done.set()
+                self._jobs.pop(job.key, None)
+            self._queue.clear()
+            self._wake.set()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """True once no compile is in flight (the queue is already empty
+        or dropped by :func:`close`)."""
+        return self._idle.wait(timeout)

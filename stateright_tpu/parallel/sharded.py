@@ -41,6 +41,7 @@ the table at 25% shard load before bucket overflows become likely.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -62,9 +63,10 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..checker.base import CheckerBuilder
 from ..core import Expectation
-from ..ops.buckets import SLOTS, bucket_insert
+from ..ops.buckets import SLOTS, bucket_insert, window_unique
 from ..ops.hashing import EMPTY, row_hash
 from ._base import WavefrontChecker
+from .prewarm import CompileWatch, donation_supported
 
 def _to_varying(x):
     """Mark a per-device array as varying over the mesh axis (vma typing).
@@ -100,13 +102,22 @@ def _build_sharded_run(
     sym: bool = False,
     steps: int = 16,
     cand_local: Optional[int] = None,
+    prededup: bool = False,
 ):
     """Build the jitted whole-run shard_map for fixed per-device capacities.
 
     ``cand_local`` is the per-device valid-candidate compaction budget for
     the owner-side insert (see ``bucket_insert``); a step whose routed
     candidates exceed it reports ``_CAND_OVERFLOW`` atomically and the host
-    doubles the budget and replays."""
+    doubles the budget and replays.
+
+    ``prededup`` masks intra-window duplicate candidates to EMPTY
+    (``ops/buckets.window_unique``) BEFORE the all-to-all routing, so a
+    duplicate-heavy expansion window pays neither ICI transfer nor
+    owner-side insert width for its copies.  Per-device only: duplicates
+    generated on different devices still meet (and dedup) at the owner.
+    Counts/traces are bit-identical either way (same contract as the
+    single-device engine; pinned by tests)."""
     ndev = mesh.shape[AXIS]
     width, arity = tensor.width, tensor.max_actions
     n_props = len(props)
@@ -303,6 +314,10 @@ def _build_sharded_run(
             # frontier carries original rows (see wavefront.py step)
             krows = tensor.representative_rows(succ) if sym else succ
             cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m_cand)
+            if prededup:
+                # intra-window pre-dedup before routing: duplicate lanes
+                # drop out of the all-to-all AND the owner-side insert
+                cand_fp = window_unique(cand_fp)
             cand_rows = succ.reshape(m_cand, width)
             cand_par = jnp.broadcast_to(fps[:, None], (fcap_local, arity)).reshape(-1)
             cand_ebt = jnp.broadcast_to(ebits[:, None], (fcap_local, arity)).reshape(-1)
@@ -391,7 +406,12 @@ def _build_sharded_run(
         shard_map(
             device_steps, mesh, in_specs=in_specs, out_specs=out_specs
         ),
-        donate_argnums=tuple(range(10)),
+        # donation only where it is real: on CPU the persistent-cache
+        # deserialization path mis-applies donation metadata and returns
+        # garbage (see prewarm.donation_supported / docs/perf.md)
+        donate_argnums=(
+            tuple(range(10)) if donation_supported() else ()
+        ),
     )
     return init_fn, step_fn
 
@@ -747,6 +767,7 @@ class ShardedTpuChecker(WavefrontChecker):
 
         pending = None  # host carry to feed step_fn (resume or post-growth)
         finished = None  # carry of an already-complete resume snapshot
+        first_build = True  # compile-event kind: the first build is "init"
         if self._resume is not None:
             carry0 = [np.asarray(self._resume[k])
                       for k in _SHARDED_SNAPSHOT_KEYS]
@@ -767,7 +788,7 @@ class ShardedTpuChecker(WavefrontChecker):
             cand_local = max(64, cf * fcap)
             sym = self._symmetry is not None
             key = (mesh_key, cap, fcap, bucket_cap, cand_local, self._target,
-                   sym, self._steps)
+                   sym, self._steps, self._prededup)
             fns = cache.get(key)
             if rec is not None and key != getattr(
                 self, "_last_engine_key", None
@@ -779,22 +800,30 @@ class ShardedTpuChecker(WavefrontChecker):
                     else "compile_cache_misses"
                 )
                 if fns is None:
-                    rec.record(
+                    # duration/cache_hit amended once the first device call
+                    # pays the lazy compile (see the sync loop below)
+                    self._pending_compile_rec = rec.record(
                         "compile", cap=cap * self.ndev, fcap=fcap,
                         bucket_cap=bucket_cap, cand=cand_local,
+                        rung="init" if first_build else "growth",
+                        source="fresh", cache_hit=False, duration=0.0,
                     )
             self._last_engine_key = key
+            first_build = False
             if fns is None:
                 fns = _build_sharded_run(
                     self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
                     self._target, sym=sym, steps=self._steps,
-                    cand_local=cand_local,
+                    cand_local=cand_local, prededup=self._prededup,
                 )
                 cache[key] = fns
             init_fn, step_fn = fns
             from_init = False
+            watch = CompileWatch() if rec is not None else None
+            t_call = time.monotonic()
             if finished is not None:
                 out = tuple(jnp.asarray(c) for c in finished) + (jnp.int32(0),)
+                watch = None
             elif pending is not None:
                 out = step_fn(*pending)
                 pending = None
@@ -809,6 +838,31 @@ class ShardedTpuChecker(WavefrontChecker):
                 unique, scount, depth, status, more, disc = jax.device_get(
                     (out[5], out[6], out[8], out[9], out[10], out[7])
                 )
+                if rec is not None and watch is not None:
+                    # the device_get above blocked on the dispatched block:
+                    # dispatch-to-materialize is the real device+compile wall
+                    dt = time.monotonic() - t_call
+                    d = watch.delta()
+                    comp = min(max(d["compile_secs"], 0.0), dt)
+                    self._stage("compile", comp)
+                    self._stage("device", dt - comp)
+                    if self._pending_compile_rec is not None:
+                        if comp > 0:
+                            prev = self._pending_compile_rec
+                            hit = (bool(prev.get("cache_hit"))
+                                   or d["persistent_hits"] > 0)
+                            rec.amend(
+                                prev,
+                                duration=round(
+                                    float(prev.get("duration", 0.0)) + comp,
+                                    6,
+                                ),
+                                cache_hit=hit,
+                                source="persistent" if hit else "fresh",
+                            )
+                        else:  # converged: stop amending this event
+                            self._pending_compile_rec = None
+                    watch = None
                 unique, scount, depth, status, more = (
                     int(unique), int(scount), int(depth), int(status),
                     int(more),
@@ -842,6 +896,8 @@ class ShardedTpuChecker(WavefrontChecker):
                     break
                 if self._profiler is not None:
                     self._profiler.maybe_start()
+                watch = CompileWatch() if rec is not None else None
+                t_call = time.monotonic()
                 out = step_fn(*carry)
                 from_init = False
                 if self._profiler is not None:
@@ -883,9 +939,11 @@ class ShardedTpuChecker(WavefrontChecker):
                     # and performs the identical per-shard transform on its
                     # own addressable data (lockstep growth).
                     self.growth_events.append((status, unique))
+                    t_grow = time.monotonic()
                     cap, fcap, bf, cf, pending = self._grow_carry_lockstep(
                         carry, cap, fcap, bf, cf, status
                     )
+                    self._stage("growth", time.monotonic() - t_grow)
                 continue
             break
         self._cap_local, self._fcap_local, self._bucket_factor = cap, fcap, bf

@@ -57,7 +57,6 @@ from __future__ import annotations
 
 import threading
 import time
-from functools import partial
 from typing import Optional
 
 import numpy as np
@@ -67,9 +66,10 @@ import jax.numpy as jnp
 
 from ..checker.base import CheckerBuilder
 from ..core import Expectation
-from ..ops.buckets import SLOTS, bucket_insert, host_bucket_rehash
+from ..ops.buckets import SLOTS, bucket_insert, host_bucket_rehash, window_unique
 from ..ops.hashing import EMPTY, row_hash
 from ._base import WavefrontChecker
+from .prewarm import CompileWatch, donation_supported
 
 _STATUS_OK = 0
 _STATUS_QUEUE_FULL = 1
@@ -125,7 +125,7 @@ def _stats_np(carry) -> np.ndarray:
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
                   sym: bool = False, cand: Optional[int] = None,
-                  checked: bool = False):
+                  checked: bool = False, prededup: bool = False):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -138,6 +138,13 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     count exceeds it reports ``_STATUS_CAND_FULL`` without writing anything
     and the host doubles the budget and replays — self-tuning, like the
     other capacities.
+
+    ``prededup`` masks intra-window duplicate candidate fingerprints to
+    EMPTY (``ops/buckets.window_unique``) before the insert, shrinking the
+    insert pipeline's effective width to the window's unique count.  The
+    inserted set, counts, and traces are bit-identical either way (the
+    filter keeps exactly the lane the insert's stable sort would keep);
+    off by default, and off means zero extra ops in the step jaxpr.
 
     ``checked`` is the sanitizer's dynamic guard
     (``stateright_tpu/analysis/sanitizer.py``): the MODEL kernels
@@ -267,6 +274,12 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # preserves the reference's pinned symmetry counts (2pc.rs:138).
         krows = tensor.representative_rows(succ) if sym else succ
         cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m)
+        if prededup:
+            # intra-window pre-dedup (BLEST-style): duplicate lanes become
+            # EMPTY so the compaction budget, membership gathers, and rank
+            # pipeline run at the window's UNIQUE count.  scount deliberately
+            # still sums ``valid`` (generated states, duplicates included).
+            cand_fp = window_unique(cand_fp)
         cand_rows = succ.reshape(m, width)
         cand_par = jnp.broadcast_to(fps[:, None], (batch, arity)).reshape(-1)
         cand_ebt = jnp.broadcast_to(ebits[:, None], (batch, arity)).reshape(-1)
@@ -351,12 +364,23 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             carry[_DISC],
         ])
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def run_fn(carry):
+    def _run_impl(carry):
         _, carry = jax.lax.while_loop(
             cond, lambda s: (s[0] + 1, step(s[1])), (jnp.int32(0), carry)
         )
         return carry, stats_of(carry)
+
+    # Donate the carry only where donation is real.  The CPU backend
+    # ignores donation at execution time, but jax 0.4.x's persistent-cache
+    # DESERIALIZATION path still applies the donation metadata — a
+    # cache-retrieved executable then reads buffers jax already marked
+    # deleted, returning garbage counters (caught by the verify drive;
+    # docs/perf.md).  Dropping the request on CPU changes nothing for a
+    # fresh compile and makes cache retrieval sound.
+    if donation_supported():
+        run_fn = jax.jit(_run_impl, donate_argnums=(0,))
+    else:
+        run_fn = jax.jit(_run_impl)
 
     @jax.jit
     def init_fn():
@@ -416,6 +440,40 @@ def _repad_queue(carry_np: list, qalloc: int) -> None:
             fill = EMPTY if i == _QFP else 0
             arr = np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
         carry_np[i] = arr[:qalloc] if arr.ndim == 1 else arr[:qalloc, :]
+
+
+def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
+                 checked: bool) -> tuple:
+    """Abstract carry signature of the engine built for these capacities —
+    what ahead-of-time compilation (``run_fn.lower(avals).compile()``)
+    needs instead of concrete arrays.  Must mirror ``init_fn``'s output
+    exactly (shapes, dtypes, tuple order); the prewarm test drives a
+    prewarmed executable with real carries, which pins the agreement."""
+    import jax
+
+    width, arity = tensor.width, tensor.max_actions
+    qalloc = qcap + batch * arity
+    sds = jax.ShapeDtypeStruct
+    avals = (
+        sds((cap,), jnp.uint64), sds((cap,), jnp.uint64),
+        sds((qalloc, width), jnp.uint64), sds((qalloc,), jnp.uint64),
+        sds((qalloc,), jnp.uint32), sds((qalloc,), jnp.uint32),
+        sds((), jnp.int32), sds((), jnp.int32),
+        sds((), jnp.int64), sds((), jnp.int64),
+        sds((max(n_props, 1),), jnp.uint64),
+        sds((), jnp.int32), sds((), jnp.int32),
+    )
+    if checked:
+        avals = avals + (sds((), jnp.bool_),)
+    return avals
+
+
+def _aot_compile(run_fn, avals):
+    """Compile the jitted run program ahead of time for the given carry
+    signature.  The returned executable is the same program the lazy path
+    would compile on first call (donation included) — kept as a
+    module-level hook so tests can observe/instrument prewarm compiles."""
+    return run_fn.lower(avals).compile()
 
 
 class TpuChecker(WavefrontChecker):
@@ -488,40 +546,140 @@ class TpuChecker(WavefrontChecker):
 
     # -- run loop ------------------------------------------------------------
 
-    def _engine(self, cap, qcap, batch, cand):
+    def _engine_cache(self) -> dict:
         cache = getattr(self.tensor, "_run_cache", None)
         if cache is None:
             cache = {}
             self.tensor._run_cache = cache
-        sym = self._symmetry is not None
-        key = (cap, qcap, batch, cand, self._steps, self._target,
-               self._pallas, sym, self._checked)
+        return cache
+
+    def _engine_key(self, cap, qcap, batch, cand) -> tuple:
+        return (cap, qcap, batch, cand, self._steps, self._target,
+                self._pallas, self._symmetry is not None, self._checked,
+                self._prededup)
+
+    def _build(self, cap, qcap, batch, cand):
+        return _build_engine(
+            self.tensor, self._props, cap, qcap, batch, self._steps,
+            self._target, pallas=self._pallas,
+            sym=self._symmetry is not None, cand=cand,
+            checked=self._checked, prededup=self._prededup,
+        )
+
+    def _engine(self, cap, qcap, batch, cand, kind: str = "growth"):
+        """The compiled engine for these capacities, through (in order) the
+        in-memory compiled-run cache on the tensor twin, the background
+        prewarmer (growth rungs compiled ahead of time), or a cold build.
+        Compile events record which path served the rung and how long the
+        run actually waited for it (docs/perf.md attribution)."""
+        cache = self._engine_cache()
+        key = self._engine_key(cap, qcap, batch, cand)
         eng = cache.get(key)
-        if (
-            self.flight_recorder is not None
-            and key != getattr(self, "_last_engine_key", None)
-        ):
+        rec = self.flight_recorder
+        fresh_acquire = key != getattr(self, "_last_engine_key", None)
+        if rec is not None and fresh_acquire:
             # compiled-run cache accounting: a miss means a fresh trace +
             # XLA compile is about to be paid (growth events recompile).
             # Only counted when the engine is (re)ACQUIRED — the run loop
             # re-fetches run_fn every sync, which must not inflate hits.
-            self.flight_recorder.add(
+            rec.add(
                 "compile_cache_hits" if eng is not None
                 else "compile_cache_misses"
             )
-            if eng is None:
-                self.flight_recorder.record(
-                    "compile", cap=cap, qcap=qcap, batch=batch, cand=cand,
-                )
         self._last_engine_key = key
-        if eng is None:
-            eng = _build_engine(
-                self.tensor, self._props, cap, qcap, batch, self._steps,
-                self._target, pallas=self._pallas, sym=sym, cand=cand,
-                checked=self._checked,
+        if eng is not None:
+            return eng
+        if self._prewarmer is not None:
+            try:
+                taken = self._prewarmer.take(key)
+            except Exception:  # noqa: BLE001 - a failed background compile
+                taken = None  # falls back to the cold path below
+            if taken is not None:
+                eng, waited, was_ready, job = taken
+                cache[key] = eng
+                self._pending_compile_rec = None
+                # time spent blocked on the in-flight background compile is
+                # compile-stall wall time; a ready rung costs ~0 here (the
+                # growth-stall elision the prewarm exists for)
+                self._stage("compile", waited)
+                if rec is not None:
+                    rec.add("prewarm_consumed")
+                    rec.record(
+                        "compile", cap=cap, qcap=qcap, batch=batch,
+                        cand=cand, rung=kind, source="prewarm",
+                        cache_hit=True, prewarm_ready=was_ready,
+                        duration=round(waited, 6),
+                        build_secs=round(job.compile_secs, 6),
+                    )
+                self._schedule_prewarm(cap, qcap, batch, cand)
+                return eng
+        if rec is not None:
+            # duration/cache_hit are amended by the run loop after the
+            # first device call actually pays the (lazy) compile
+            self._pending_compile_rec = rec.record(
+                "compile", cap=cap, qcap=qcap, batch=batch, cand=cand,
+                rung=kind, source="fresh", cache_hit=False, duration=0.0,
             )
-            cache[key] = eng
+        eng = self._build(cap, qcap, batch, cand)
+        cache[key] = eng
         return eng
+
+    def _maybe_schedule_prewarm(self, cap, qcap, batch, cand,
+                                unique: int, tail: int) -> None:
+        """Threshold gate for prediction scheduling: background compiles
+        start only once a growth trigger is actually approaching (table
+        at 1/16 load vs the 1/4 trigger; queue tail at half the
+        high-water mark) — a pre-sized run that never grows never pays a
+        single background compile, which keeps prewarm's overhead at
+        exactly zero for the runs that don't need it."""
+        if self._prewarmer is None:
+            return
+        if unique * 16 > cap or tail * 2 > qcap:
+            self._schedule_prewarm(cap, qcap, batch, cand)
+
+    def _schedule_prewarm(self, cap, qcap, batch, cand) -> None:
+        """Queue ahead-of-time compiles for the growth ladder's predicted
+        next rungs: the table doubling, the queue doubling, and the
+        candidate-budget doubling (``_grow`` / the cand-full replay only
+        ever move capacities along these edges).  Called from the
+        threshold gate above and — growth momentum — after a prewarmed
+        rung is consumed.  A wrong prediction costs one wasted background
+        compile; a right one turns the next growth boundary's cold
+        compile into an instant swap."""
+        if self._prewarmer is None:
+            return
+        cache = self._engine_cache()
+        arity = self.tensor.max_actions
+        rungs = [(cap * 2, qcap, cand), (cap, qcap * 2, cand)]
+        cand2 = min(cand * 2, batch * arity)
+        if cand2 != cand:
+            nc = cap
+            while cand2 * 4 > nc:  # the cand-full replay pre-sizes the table
+                nc *= 2
+            rungs.append((nc, qcap, cand2))
+        keys = [self._engine_key(nc_, nq_, batch, ncd_)
+                for nc_, nq_, ncd_ in rungs]
+        # predictions from superseded capacities are dead rungs: cancel
+        # queued ones (they would delay the useful compile on the single
+        # worker) and release finished executables nobody can consume
+        self._prewarmer.prune(keys)
+        for (ncap, nqcap, ncand), key in zip(rungs, keys):
+            if key in cache or self._prewarmer.scheduled(key):
+                continue
+            checked, n_props = self._checked, len(self._props)
+            tensor = self.tensor
+
+            def build(ncap=ncap, nqcap=nqcap, ncand=ncand):
+                init_fn, run_fn = self._build(ncap, nqcap, batch, ncand)
+                exe = _aot_compile(
+                    run_fn,
+                    _carry_avals(tensor, n_props, ncap, nqcap, batch,
+                                 checked),
+                )
+                return init_fn, exe
+            if self._prewarmer.schedule(key, build):
+                if self.flight_recorder is not None:
+                    self.flight_recorder.add("prewarm_scheduled")
 
     def _raise_on_checked_error(self, carry, head: int, tail: int,
                                 batch: int) -> None:
@@ -619,6 +777,55 @@ class TpuChecker(WavefrontChecker):
         return cap, qcap, carry_np
 
     def _run(self):
+        try:
+            self._run_impl()
+        finally:
+            if self._prewarmer is not None:
+                # stop the background compiler with the run (its daemon
+                # thread would otherwise idle for the process lifetime)
+                self._prewarmer.close()
+
+    def _timed_device_call(self, fn, arg=None):
+        """Run one device call (init or a steps block), splitting its wall
+        time into compile vs device execution via the jax monitoring
+        deltas, and amend the pending compile event with the measured
+        duration.  Blocking on the packed stats vector is what makes the
+        wall time real (dispatch alone returns immediately)."""
+        rec = self.flight_recorder
+        watch = CompileWatch() if rec is not None else None
+        t0 = time.monotonic()
+        carry, stats = fn() if arg is None else fn(arg)
+        carry = list(carry)
+        stats = np.asarray(stats)
+        if rec is not None:
+            dt = time.monotonic() - t0
+            d = watch.delta()
+            comp = min(max(d["compile_secs"], 0.0), dt)
+            self._stage("compile", comp)
+            self._stage("device", dt - comp)
+            if self._pending_compile_rec is not None:
+                # accumulate: one engine acquisition covers two programs
+                # (init_fn + run_fn) whose lazy compiles land on different
+                # calls; once a call measures zero compile the event has
+                # converged and stops amending (a later rung records its
+                # own event)
+                if comp > 0:
+                    prev = self._pending_compile_rec
+                    hit = (bool(prev.get("cache_hit"))
+                           or d["persistent_hits"] > 0)
+                    rec.amend(
+                        prev,
+                        duration=round(
+                            float(prev.get("duration", 0.0)) + comp, 6
+                        ),
+                        cache_hit=hit,
+                        source="persistent" if hit else "fresh",
+                    )
+                else:
+                    self._pending_compile_rec = None
+        return carry, stats
+
+    def _run_impl(self):
         cap, qcap, batch = self._cap, self._qcap, self._batch
         arity = self.tensor.max_actions
         cand = min(self._cand, batch * arity)
@@ -651,10 +858,9 @@ class TpuChecker(WavefrontChecker):
                 carry = list(carry) + [jnp.bool_(False)]
         else:
             while True:
-                init_fn, _ = self._engine(cap, qcap, batch, cand)
-                carry, stats = init_fn()
-                carry = list(carry)
-                stats = np.asarray(stats)
+                init_fn, _ = self._engine(cap, qcap, batch, cand,
+                                          kind="init")
+                carry, stats = self._timed_device_call(init_fn)
                 # init insertion must be atomic: a table-full at init means
                 # nothing was written, so grow statically and re-init rather
                 # than resuming an inconsistent carry.  A queue-full init is
@@ -725,6 +931,7 @@ class TpuChecker(WavefrontChecker):
                     "configuration actually reaches)."
                 )
             if status != _STATUS_OK:
+                t_grow = time.monotonic()
                 self.growth_events.append((status, unique))
                 if rec is not None:
                     rec.record(
@@ -755,6 +962,7 @@ class TpuChecker(WavefrontChecker):
                         )
                         carry = [jnp.asarray(c) for c in carry_np]
                     carry = list(carry) + err_tail
+                    self._stage("growth", time.monotonic() - t_grow)
                     stats = None
                     continue
                 carry_np = [np.asarray(c) for c in carry]
@@ -775,6 +983,7 @@ class TpuChecker(WavefrontChecker):
                         h2d=sum(a.nbytes for a in carry_np if a.ndim)
                     )
                 carry = [jnp.asarray(c) for c in carry_np] + err_tail
+                self._stage("growth", time.monotonic() - t_grow)
                 stats = None
                 continue
             if self._stop.is_set():
@@ -786,12 +995,11 @@ class TpuChecker(WavefrontChecker):
                 done = True
             if done:
                 break
+            self._maybe_schedule_prewarm(cap, qcap, batch, cand, unique, tail)
             _, run_fn = self._engine(cap, qcap, batch, cand)
             if self._profiler is not None:
                 self._profiler.maybe_start()
-            carry, stats = run_fn(tuple(carry))
-            carry = list(carry)
-            stats = np.asarray(stats)
+            carry, stats = self._timed_device_call(run_fn, tuple(carry))
             if self._profiler is not None:
                 self._profiler.tick()
 

@@ -135,6 +135,16 @@ class FlightRecorder:
         with self._lock:
             self._counters[counter] = self._counters.get(counter, 0) + n
 
+    def amend(self, rec: dict, **fields) -> None:
+        """Update a previously returned record in place (under the lock:
+        the Explorer may be snapshotting concurrently).  Used for values
+        that are only measurable after the record's moment — e.g. a
+        ``compile`` event recorded at engine acquisition whose duration is
+        the NEXT device call's measured compile time (the lazy-jit path
+        pays the compile there, not at acquisition)."""
+        with self._lock:
+            rec.update(fields)
+
     def add_bytes(self, *, h2d: int = 0, d2h: int = 0) -> None:
         if h2d:
             self.add("h2d_bytes", int(h2d))
@@ -184,6 +194,35 @@ class FlightRecorder:
         with self._lock:
             return dict(self._counters)
 
+    def stages(self) -> Optional[dict]:
+        """Per-stage wall-time breakdown (docs/perf.md): the ``stage_*_secs``
+        aggregate counters the device engines accumulate (compile / device /
+        growth), plus the host remainder, against the recorder's wall clock.
+        None when no engine recorded stage counters (host checkers, or a
+        recorder predating the attribution round).  ``host_secs`` is
+        everything not attributed to a named stage — trace reconstruction,
+        snapshot service, loop bookkeeping, and clock skew; a large value
+        here is itself a finding."""
+        with self._lock:
+            counters = dict(self._counters)
+            last_step = self._last_step
+            t_offset = self._t_offset
+        names = {
+            k[len("stage_"):-len("_secs")]: float(v)
+            for k, v in counters.items()
+            if k.startswith("stage_") and k.endswith("_secs")
+        }
+        if not names:
+            return None
+        wall = None
+        if last_step is not None:
+            wall = max(last_step[0] - t_offset, 0.0)
+        out = {f"{k}_secs": round(v, 6) for k, v in sorted(names.items())}
+        if wall is not None:
+            out["wall_secs"] = round(wall, 6)
+            out["host_secs"] = round(max(wall - sum(names.values()), 0.0), 6)
+        return out
+
     def summary(self) -> dict:
         """Aggregate run summary (JSON-safe scalars + small dicts): totals,
         throughput, dedup ratio, event counts, transfer volume, and the
@@ -222,6 +261,12 @@ class FlightRecorder:
         for key in ("h2d_bytes", "d2h_bytes", "compile_cache_hits",
                     "compile_cache_misses", "compaction_hits"):
             out[key] = int(counters.get(key, 0))
+        for key in ("prewarm_scheduled", "prewarm_consumed"):
+            if counters.get(key):
+                out[key] = int(counters[key])
+        stages = self.stages()
+        if stages is not None:
+            out["stages"] = stages
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")

@@ -268,3 +268,96 @@ def test_driver_parse_of_last_line(bench_env, capsys):
     assert last["vs_baseline"] == 40.0
     assert "error" not in last
     assert last["tpu_paxos3_unique"] == 1_194_428
+
+
+def test_kill_reason_distinguishes_init_compile_and_run(bench_env):
+    """The watchdog's headline ``error`` classification: backend-init hang
+    vs engine-compile hang vs a genuine run-budget miss are three
+    different problems (tunnel / persistent compile cache / budget)."""
+    b = _load_bench()
+    assert b._kill_reason(True, "", 120, 900) == (
+        "stuck in backend init for 120s"
+    )
+    why = b._kill_reason(False, "compile (paxos3 engine)", 120, 900)
+    assert why.startswith("stuck in engine compile/warm-up after 900s")
+    assert "paxos3" in why
+    why = b._kill_reason(False, "paxos3 timed run done", 120, 900)
+    assert why.startswith("timed out after 900s")
+    assert "paxos3 timed run done" in why
+
+
+def test_phase_breakdown_reaches_details_file(bench_env, capsys):
+    """The per-phase/per-stage breakdown is a details-file artifact (the
+    headline line stays small): emitting it must land it in
+    docs/bench-last-details.json verbatim."""
+    b = _load_bench()
+    stages = {"compile_secs": 1.25, "device_secs": 7.5, "growth_secs": 0.1,
+              "wall_secs": 9.0, "host_secs": 0.15}
+    phases = {"backend_init_secs": 2.0, "paxos3_warmup_secs": 11.0,
+              "paxos3_run_secs": 9.0}
+    b.emit(cpu_paxos3_states_per_sec=8000.0,
+           tpu_paxos3_states_per_sec=300000.0,
+           tpu_paxos3_stages=stages, tpu_phases=phases)
+    details = json.load(open(os.environ["BENCH_DETAILS_FILE"]))
+    assert details["tpu_paxos3_stages"] == stages
+    assert details["tpu_phases"] == phases
+    for line in capsys.readouterr().out.strip().splitlines():
+        assert len(line.encode()) <= b.MAX_LINE_BYTES
+
+
+def test_record_validated_persists_stage_breakdown(bench_env):
+    b = _load_bench()
+    stages = {"compile_secs": 1.0, "device_secs": 7.0, "wall_secs": 9.0,
+              "host_secs": 1.0}
+    b.emit(cpu_paxos3_states_per_sec=7000.0, cpu_load1=0.1,
+           tpu_paxos3_states_per_sec=210000.0,
+           tpu_paxos3_stages=stages,
+           cpu_baseline_engine="native-cpp-bfs",
+           tpu_paxos2_discoveries=["value chosen"],
+           tpu_2pc5_discoveries=["abort agreement"])
+    b.record_validated()
+    doc = json.load(open(os.environ["BENCH_VALIDATED_FILE"]))
+    assert doc["tpu_paxos3_stages"] == stages
+    assert doc["cpu_baseline_engine"] == "native-cpp-bfs"
+
+
+def test_ab_table_mode_with_injected_runner(bench_env, capsys):
+    """--ab-table: both legs at the same capacity, 2pc10 targeted at
+    2pc7's unique volume, ratio on the line, full legs in the side
+    file."""
+    b = _load_bench()
+    calls = []
+
+    def fake_run(rm, target):
+        calls.append((rm, target))
+        return {"states_per_sec": 1450000.0 if rm == 7 else 866000.0,
+                "states": 10, "unique": 296448 if rm == 7 else 296000,
+                "sec": 1.0, "occupancy_last": {"load_factor": 0.1},
+                "stages": {"device_secs": 1.0}, "growth_events": 0}
+
+    rc = b.ab_table(run_one=fake_run)
+    assert rc == 0
+    assert calls == [(7, None), (10, 296448)]  # same insert volume
+    (line,) = [json.loads(l) for l in
+               capsys.readouterr().out.strip().splitlines()]
+    assert line["tpu_2pc7_states_per_sec"] == 1450000.0
+    assert line["ratio_7_over_10"] == round(1450000.0 / 866000.0, 3)
+    assert len(json.dumps(line).encode()) <= b.MAX_LINE_BYTES
+    side = os.environ["BENCH_DETAILS_FILE"].replace(
+        ".json", "-ab-table.json"
+    )
+    full = json.load(open(side))
+    assert full["tpu_2pc7_ab"]["occupancy_last"] == {"load_factor": 0.1}
+
+
+def test_ab_table_failure_emits_one_line_rc1(bench_env, capsys):
+    b = _load_bench()
+
+    def broken(rm, target):
+        raise RuntimeError("tunnel down")
+
+    rc = b.ab_table(run_one=broken)
+    assert rc == 1
+    (line,) = [json.loads(l) for l in
+               capsys.readouterr().out.strip().splitlines()]
+    assert "tunnel down" in line["error"]

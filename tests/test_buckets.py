@@ -258,3 +258,78 @@ def test_bucket_chi_square_on_structured_streams(stream):
     assert chi2 < 400.0, chi2
     # and no bucket anywhere near a SLOTS-deep pile-up at this load
     assert counts.max() < 2 * expect
+
+
+# -- intra-window pre-dedup (ops/buckets.window_unique) -----------------------
+
+
+def test_window_unique_keeps_first_occurrence_and_empty_lanes():
+    from stateright_tpu.ops.buckets import window_unique
+
+    fps = np_u64([5, EMPTY, 9, 5, 7, 9, 5, EMPTY])
+    out = np.asarray(window_unique(jnp.asarray(fps)))
+    # first occurrence (lowest lane) survives; later copies become EMPTY
+    assert out.tolist() == np_u64(
+        [5, EMPTY, 9, EMPTY, 7, EMPTY, EMPTY, EMPTY]
+    ).tolist()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_window_unique_then_insert_is_bit_identical(seed):
+    """The equivalence contract behind the engines' prededup flag: running
+    ``bucket_insert`` on a pre-deduped window must produce the identical
+    table, payloads, n_new, and selected prefix — in BOTH compaction
+    orders — because the filter keeps exactly the lane the insert's
+    stable sort would have kept."""
+    from stateright_tpu.ops.buckets import window_unique
+
+    rng = np.random.default_rng(seed)
+    fps = rng.integers(1, 50, size=256, dtype=np.uint64)  # heavy duplication
+    fps[rng.random(256) < 0.3] = np.uint64(EMPTY)
+    payloads = np_u64(np.arange(1, 257))
+    for generation_order in (False, True):
+        for compact in (None, 224):  # budget sized so neither side overflows
+            tfp0, tpl0 = fresh(16)
+            plain = bucket_insert(
+                tfp0, tpl0, jnp.asarray(fps), jnp.asarray(payloads),
+                window=32, generation_order=generation_order,
+                compact=compact,
+            )
+            tfp1, tpl1 = fresh(16)
+            dedup = bucket_insert(
+                tfp1, tpl1, window_unique(jnp.asarray(fps)),
+                jnp.asarray(payloads), window=32,
+                generation_order=generation_order, compact=compact,
+            )
+            assert not bool(plain[5]) and not bool(dedup[5])  # no cand ovfl
+            assert int(plain[3]) == int(dedup[3])  # n_new
+            n = int(plain[3])
+            assert np.array_equal(np.asarray(plain[0]), np.asarray(dedup[0]))
+            assert np.array_equal(np.asarray(plain[1]), np.asarray(dedup[1]))
+            assert np.array_equal(
+                np.asarray(plain[2])[:n], np.asarray(dedup[2])[:n]
+            )  # the consumed sel prefix
+            assert not bool(plain[4]) and not bool(dedup[4])
+
+
+def test_window_unique_shrinks_candidate_pressure():
+    """The point of the filter: a duplicate-heavy window that cand-
+    overflows a tight compaction budget FITS once pre-deduped (fewer
+    growth/replay events on the engines, never more)."""
+    from stateright_tpu.ops.buckets import window_unique
+
+    rng = np.random.default_rng(3)
+    fps = rng.integers(1, 33, size=256, dtype=np.uint64)  # ~32 unique
+    payloads = np_u64(np.arange(1, 257))
+    tfp, tpl = fresh(16)
+    plain = bucket_insert(
+        tfp, tpl, jnp.asarray(fps), jnp.asarray(payloads),
+        window=32, compact=64,
+    )
+    assert bool(plain[5]) and int(plain[3]) == 0  # overflowed, wrote nothing
+    tfp, tpl = fresh(16)
+    dedup = bucket_insert(
+        tfp, tpl, window_unique(jnp.asarray(fps)), jnp.asarray(payloads),
+        window=32, compact=64,
+    )
+    assert not bool(dedup[5]) and int(dedup[3]) > 0

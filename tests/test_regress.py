@@ -183,3 +183,51 @@ def test_sanitizer_verdict_crash_is_a_failure():
 
     v = r.sanitizer_verdict(fleet=broken)
     assert v["clean"] is False and "boom" in v["error"]
+
+
+def test_stages_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--stages: a FRESH run must carry a well-formed per-stage breakdown;
+    stored baselines without stages (pre-attribution hardware numbers)
+    never trip the gate, and staleness still wins with exit 2."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # note: baseline has no stages
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    stages = {"compile_secs": 1.0, "device_secs": 7.0, "growth_secs": 0.2,
+              "wall_secs": 9.0, "host_secs": 0.8}
+    # fresh + stages present -> ok, baseline absence is informational
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+                 "tpu_paxos3_stages": stages}, "--stages")
+    assert rc == 0 and v["ok"] is True
+    assert v["stages"]["ok"] is True and v["stages"]["baseline"] is None
+    assert v["stages"]["run"] == stages
+    # fresh but NO stages -> exit 1, named in the verdict
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0},
+                "--stages")
+    assert rc == 1 and v["ok"] is False and v["stages"]["ok"] is False
+    # malformed (negative) stage -> exit 1
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+                 "tpu_paxos3_stages": {"device_secs": -1.0}}, "--stages")
+    assert rc == 1 and v["stages"]["malformed"] == ["device_secs"]
+    # stale run: staleness exits 2 regardless of stages
+    rc, v = run({"fresh": False}, "--stages")
+    assert rc == 2
+    # --allow-stale: a stored artifact without stages is NOT required to
+    # have them (it predates the attribution round)
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0},
+                "--stages", "--allow-stale")
+    assert rc == 0 and v["stages"]["ok"] is False  # reported, not gated
+    # baseline WITH stages is attached for comparison
+    base.write_text(json.dumps({**BASELINE,
+                                "tpu_paxos3_stages": stages}))
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+                 "tpu_paxos3_stages": stages}, "--stages")
+    assert rc == 0 and v["stages"]["baseline"] == stages
