@@ -33,7 +33,13 @@ recorder summary (``stateright_tpu/telemetry/``) embedded as
 ``tpu_paxos3_telemetry`` / ``tpu_2pc7_telemetry`` in the details artifact
 — per-step throughput, dedup ratio, growth events, occupancy, transfer
 volume — so every future perf claim has its time series on record.
-``regress.py`` gates a fresh run's summary against BENCH_VALIDATED.json.
+Both legs run with the search-cartography counters on and embed their
+post-run report (``telemetry/report.py``) as ``tpu_paxos3_report`` /
+``tpu_2pc7_report`` plus the raw ``*_cartography`` block, so the numbers
+arrive with the search shape (depth/action mix, property coverage, shard
+balance) that explains them.  ``regress.py`` gates a fresh run's summary
+against BENCH_VALIDATED.json (``--cartography`` for the block's
+well-formedness).
 
 ``value``/``vs_baseline`` are recomputed on every emit from whatever
 numbers exist so far.
@@ -306,6 +312,10 @@ def record_validated() -> None:
     # ``regress.py --stages`` can compare like against like
     if EXTRAS.get("tpu_paxos3_stages"):
         doc["tpu_paxos3_stages"] = EXTRAS["tpu_paxos3_stages"]
+    # ...and the cartography block, so ``regress.py --cartography`` can
+    # diff search shape (depth/action mix, shard balance) across rounds
+    if EXTRAS.get("tpu_paxos3_cartography"):
+        doc["tpu_paxos3_cartography"] = EXTRAS["tpu_paxos3_cartography"]
     if EXTRAS.get("tpu_phases"):
         doc["tpu_phases"] = EXTRAS["tpu_phases"]
     pallas = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
@@ -597,8 +607,11 @@ def tpu_phase() -> dict:
     def spawn3():
         # flight recorder on (stateright_tpu/telemetry/): host-side only,
         # <3% overhead contract (pinned in tests/test_telemetry.py), and
-        # the per-step series is the artifact the perf round needs
-        b = m3.checker().telemetry(capacity=2048)
+        # the per-step series is the artifact the perf round needs.
+        # Cartography counters ride the step (<=5% pin, well inside the
+        # regress tolerance): the headline number and the run report that
+        # explains it come from the SAME run (docs/telemetry.md).
+        b = m3.checker().telemetry(capacity=2048, cartography=True)
         if target:
             b = b.target_states(int(target))
         return b.spawn_tpu(sync=True, **caps)
@@ -612,7 +625,12 @@ def tpu_phase() -> dict:
     phases["paxos3_run_secs"] = round(dt, 3)
     _mark("paxos3 timed run done")
     if tpu_p3.flight_recorder is not None:
-        out["tpu_paxos3_telemetry"] = tpu_p3.flight_recorder.summary()
+        summ3 = tpu_p3.flight_recorder.summary()
+        # the cartography block is embedded once as tpu_paxos3_cartography
+        # (the regress.py --cartography contract key) and once inside the
+        # self-contained report — not a third time here
+        summ3.pop("cartography", None)
+        out["tpu_paxos3_telemetry"] = summ3
         # the per-stage attribution (init-compile / rung-compile /
         # device-step / growth / host) of the TIMED run — the numbers the
         # >=1M states/s chase is driven by (docs/perf.md)
@@ -626,6 +644,20 @@ def tpu_phase() -> dict:
                  ("rung", "source", "cache_hit", "duration", "cap")}
                 for c in compiles
             ]
+        # the embedded post-run report (telemetry/report.py): cartography
+        # + deterministic health timeline — what regress.py --cartography
+        # gates and what the on-chip measurement rounds read to interpret
+        # their numbers
+        try:
+            from stateright_tpu.telemetry.report import build_report
+
+            out["tpu_paxos3_report"] = build_report(tpu_p3)
+        except Exception as e:  # noqa: BLE001 - report loss must not
+            # void the measured number
+            out["tpu_paxos3_report_error"] = f"{type(e).__name__}: {e}"
+        cart3 = tpu_p3.cartography()
+        if cart3 is not None:
+            out["tpu_paxos3_cartography"] = cart3
     out["tpu_paxos3_states_per_sec"] = round(tpu_p3.state_count() / dt, 1)
     out["tpu_paxos3_states"] = tpu_p3.state_count()
     out["tpu_paxos3_unique"] = tpu_p3.unique_state_count()
@@ -712,15 +744,31 @@ def tpu_phase() -> dict:
         # doubling recompiles the engine, wasting warm-up budget
         caps7 = dict(capacity=1 << 21, queue_capacity=1 << 19, batch=2048,
                      steps_per_call=256, cand=1 << 15)
-        t7.checker().spawn_tpu(sync=True, **caps7)  # warm-up
-        tpu_t7, dt7 = timed(
-            lambda: t7.checker().telemetry(capacity=2048)
+        # warm-up must build the SAME engine as the timed run: cartography
+        # changes the step program (and the engine cache key), so a plain
+        # warm-up would leave the timed run paying the cold compile
+        spawn7 = lambda: (  # noqa: E731
+            t7.checker().telemetry(capacity=2048, cartography=True)
             .spawn_tpu(sync=True, **caps7)
         )
+        spawn7()  # warm-up
+        tpu_t7, dt7 = timed(spawn7)
         if tpu_t7.flight_recorder is not None:
             # the 2pc7-vs-2pc10 table-size anomaly (VERDICT.md) is
             # diagnosed from exactly this series
-            out["tpu_2pc7_telemetry"] = tpu_t7.flight_recorder.summary()
+            summ7 = tpu_t7.flight_recorder.summary()
+            summ7.pop("cartography", None)  # embedded as the standalone
+            # tpu_2pc7_cartography key and inside the report already
+            out["tpu_2pc7_telemetry"] = summ7
+            try:
+                from stateright_tpu.telemetry.report import build_report
+
+                out["tpu_2pc7_report"] = build_report(tpu_t7)
+            except Exception as e:  # noqa: BLE001
+                out["tpu_2pc7_report_error"] = f"{type(e).__name__}: {e}"
+            cart7 = tpu_t7.cartography()
+            if cart7 is not None:
+                out["tpu_2pc7_cartography"] = cart7
         out["tpu_2pc7_states_per_sec"] = round(tpu_t7.state_count() / dt7, 1)
         out["tpu_2pc7_states"] = tpu_t7.state_count()
         out["tpu_2pc7_unique"] = tpu_t7.unique_state_count()

@@ -7,7 +7,7 @@ staleness and regressions LOUD:
 
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
-                      [--stages]
+                      [--stages] [--cartography]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -112,6 +112,63 @@ def sanitizer_verdict(fleet=None) -> dict:
     }
 
 
+def cartography_verdict(run: dict, baseline: dict) -> dict:
+    """``--cartography``: the search-cartography section
+    (docs/telemetry.md).
+
+    A FRESH run must carry a WELL-FORMED ``tpu_paxos3_cartography`` block
+    — versioned, with non-empty depth/action histograms whose totals
+    reconcile against the run's own headline counters when those are
+    present (``sum(depth_hist) == fresh_inserts`` and, when the run
+    carries ``tpu_paxos3_unique``, ``fresh_inserts`` equals it).  The
+    baseline's block is attached for comparison when present but NEVER
+    gates: stored baselines predating the cartography round have none,
+    and stale artifacts must not trip a fresh run (exactly the
+    ``--stages`` rule)."""
+    cart = run.get("tpu_paxos3_cartography")
+    out: dict = {"present": bool(cart)}
+    problems = []
+    if not cart:
+        problems.append("run carries no tpu_paxos3_cartography block")
+    else:
+        if not isinstance(cart.get("v"), int):
+            problems.append("missing schema version v")
+        depth = cart.get("depth_hist") or []
+        actions = cart.get("action_hist") or []
+        if not depth or not all(
+            isinstance(x, int) and x >= 0 for x in depth
+        ):
+            problems.append("depth_hist empty or malformed")
+        if not actions or not all(
+            isinstance(x, int) and x >= 0 for x in actions
+        ):
+            problems.append("action_hist empty or malformed")
+        fresh = cart.get("fresh_inserts")
+        if not isinstance(fresh, int):
+            problems.append("missing fresh_inserts")
+        elif depth and sum(depth) != fresh:
+            problems.append(
+                f"sum(depth_hist)={sum(depth)} != fresh_inserts={fresh}"
+            )
+        unique = run.get("tpu_paxos3_unique")
+        if isinstance(fresh, int) and unique is not None and fresh != unique:
+            problems.append(
+                f"fresh_inserts={fresh} != tpu_paxos3_unique={unique}"
+            )
+        out["summary"] = {
+            "v": cart.get("v"),
+            "depth_bins": len(depth),
+            "actions": len(actions),
+            "fresh_inserts": fresh,
+            "duplicate_hits": cart.get("duplicate_hits"),
+        }
+    out["ok"] = not problems
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_paxos3_cartography"))
+    return out
+
+
 def stage_verdict(run: dict, baseline: dict) -> dict:
     """``--stages``: the per-stage attribution section (docs/perf.md).
 
@@ -145,7 +202,7 @@ def main(argv=None, fleet=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
-    stages = False
+    stages = cartography = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -158,6 +215,8 @@ def main(argv=None, fleet=None) -> int:
             sanitize = True
         elif a == "--stages":
             stages = True
+        elif a == "--cartography":
+            cartography = True
         else:
             pos.append(a)
     if pos:
@@ -189,6 +248,12 @@ def main(argv=None, fleet=None) -> int:
         # stale artifact predating the attribution round must not trip
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["stages"]["ok"]
+    if cartography:
+        verdict["cartography"] = cartography_verdict(run, baseline)
+        # same freshness rule as --stages: pre-cartography baselines and
+        # stale artifacts never trip
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["cartography"]["ok"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -219,6 +284,18 @@ def main(argv=None, fleet=None) -> int:
             "regress: fresh run carries no (or malformed) per-stage "
             "attribution (tpu_paxos3_stages) — an unattributed perf "
             "number cannot drive the >=1M states/s chase (docs/perf.md)\n"
+        )
+        return 1
+    if (
+        "cartography" in verdict
+        and verdict["fresh"]
+        and not verdict["cartography"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: fresh run carries no (or malformed) search "
+            "cartography (tpu_paxos3_cartography) — a perf number without "
+            "the search shape behind it cannot be interpreted "
+            "(docs/telemetry.md)\n"
         )
         return 1
     return 0

@@ -35,6 +35,7 @@ class CheckerBuilder:
         self.timeout_secs: Optional[float] = None
         self._audit_skip = False
         self.telemetry_opts: Optional[dict] = None
+        self.report_path: Optional[str] = None
         self.checked_mode = False
         # wavefront-throughput knobs (docs/perf.md); None = env default
         self.prewarm_mode: Optional[bool] = None
@@ -81,6 +82,7 @@ class CheckerBuilder:
         occupancy_every: int = 0,
         profile_steps: int = 0,
         profile_dir: Optional[str] = None,
+        cartography: bool = False,
     ) -> "CheckerBuilder":
         """Attach a flight recorder to the spawned checker
         (``stateright_tpu/telemetry/``; schema in ``docs/telemetry.md``).
@@ -104,18 +106,71 @@ class CheckerBuilder:
         ``profile_steps=N`` arms a scoped ``jax.profiler`` trace of the
         first N hot steps into ``profile_dir`` (device engines only).
 
+        ``cartography=True`` additionally folds the search-cartography
+        counters into the device step (``ops/cartography.py``,
+        docs/telemetry.md): per-depth frontier sizes, the per-action
+        successor histogram, per-property evaluation tallies — and on the
+        sharded engine per-shard table loads plus the routed-candidate
+        matrix.  This is the one telemetry option that changes the step
+        program (small integer reductions riding the existing packed
+        stats vector; measured ≤5% on 2pc-7, pinned in the slow tier);
+        off, the step jaxpr stays bit-identical.  The counters surface as
+        ``checker.cartography()``, the recorder's ``cartography`` block,
+        the Explorer's ``/.metrics``, and the run report.
+
         Telemetry off (the default) is exactly the pre-telemetry engine:
         zero ops added to the step jaxpr, no recorder allocated."""
         if not enabled:
             self.telemetry_opts = None
             return self
+        # A cartography flag implied earlier (``.report()``/``.cartography()``)
+        # is sticky: reconfiguring the recorder must not silently drop the
+        # counters the report contract depends on.
+        implied_cart = bool(self.telemetry_opts) and bool(
+            self.telemetry_opts.get("cartography")
+        )
         self.telemetry_opts = {
             "capacity": capacity,
             "occupancy_every": occupancy_every,
             "profile_steps": profile_steps,
             "profile_dir": profile_dir,
+            "cartography": bool(cartography) or implied_cart,
         }
         return self
+
+    def cartography(self, enabled: bool = True) -> "CheckerBuilder":
+        """Fold the search-cartography counters into the run — a
+        ``.telemetry(cartography=True)`` shorthand that composes with an
+        existing telemetry config instead of replacing it.  ``report()``
+        and the CLI ``--watch`` flag imply it; this method is the one
+        place the imply-rule mutates the telemetry options."""
+        if not enabled:
+            return self
+        if self.telemetry_opts is None:
+            self.telemetry()
+        self.telemetry_opts["cartography"] = True
+        return self
+
+    def report(self, path: str) -> "CheckerBuilder":
+        """Write a post-run report to ``path`` (JSON; a sibling ``.md``
+        rendering lands next to it) at the first ``join()`` after the run
+        completes — the artifact a human reads after an unattended on-chip
+        run (``stateright_tpu/telemetry/report.py``; docs/telemetry.md
+        "Reading a run report").  Implies telemetry with cartography: the
+        report combines the run totals, the cartography block, the health
+        timeline, growth events, and the audit/sanitizer status.  The JSON
+        body is deterministic for a fixed model/config — wall-clock-
+        dependent values live in the markdown rendering only, and the
+        single volatile JSON field is the ``generated_at`` header."""
+        import os as _os
+
+        if _os.path.splitext(str(path))[1] == ".md":
+            raise ValueError(
+                f"report path {path!r} ends in .md — pass the JSON path; "
+                "the markdown rendering lands next to it as <path-stem>.md"
+            )
+        self.report_path = str(path)
+        return self.cartography()
 
     def prewarm(self, enabled: bool = True) -> "CheckerBuilder":
         """Growth-stall elision for the single-device wavefront engine
@@ -352,16 +407,9 @@ class CheckerBuilder:
             if not can_mp:
                 return cpu_spawn()
             return probe_then(self.spawn_mp_bfs, small=cpu_spawn)
-        try:
-            cached = getattr(self.model, "_tensor_cached", None)
-            twin = (
-                cached()
-                if cached is not None
-                else getattr(self.model, "tensor_model", lambda: None)()
-            )
-        except Exception:  # noqa: BLE001 - CompileError etc: host fallback
-            twin = None
-        if twin is None:
+        from ..parallel.tensor_model import twin_or_none
+
+        if twin_or_none(self.model) is None:
             return cpu_spawn()
         return probe_then(lambda: self.spawn_tpu(**tpu_kw))
 
@@ -411,6 +459,25 @@ class Checker:
     # run telemetry (stateright_tpu/telemetry/): a FlightRecorder when the
     # builder requested .telemetry(), else None on every strategy
     flight_recorder = None
+    # post-run report (telemetry/report.py): the builder's .report(PATH),
+    # honored at the first join() after completion on EVERY strategy (host
+    # runs simply carry no cartography block)
+    _report_path: Optional[str] = None
+    _report_written = False
+
+    def _maybe_write_report(self) -> None:
+        """Write the builder-requested run report exactly once, at the
+        first join() after completion (never from inside a run thread:
+        the report reconstructs discovery paths, which joins)."""
+        if (
+            self._report_path
+            and not self._report_written
+            and self.is_done()
+        ):
+            self._report_written = True  # before write: never retry a crash
+            from ..telemetry.report import write_report
+
+            write_report(self, self._report_path)
 
     # -- strategy-provided ---------------------------------------------------
 

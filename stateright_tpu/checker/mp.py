@@ -96,6 +96,7 @@ class MpBfsChecker(ParentPointerTrace, Checker):
         # round — from the SAME barrier snapshot every worker agrees on —
         # and the parent replays the history as "step" records post-merge
         self.flight_recorder = options._make_recorder("mp")
+        self._report_path = options.report_path
         # an EXPLICIT processes count wins verbatim (processes=1 is a valid
         # single-worker debugging run); only the unset case falls through to
         # threads(N) and then to all cores
@@ -191,6 +192,7 @@ class MpBfsChecker(ParentPointerTrace, Checker):
                     engine="mp", states=count, unique=unique,
                     frontier=frontier, round=rnd, t=rec.rel(t_abs),
                 )
+            rec.close_run(done=True)
         if want_visits:
             self._replay_visits(options.visitor_obj, results)
 
@@ -223,6 +225,7 @@ class MpBfsChecker(ParentPointerTrace, Checker):
         return len(self._generated)
 
     def join(self) -> "MpBfsChecker":
+        self._maybe_write_report()
         return self
 
     def is_done(self) -> bool:

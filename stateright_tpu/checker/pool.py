@@ -45,6 +45,7 @@ class WorkerPoolChecker(Checker):
         # flight recorder (stateright_tpu/telemetry/): one "step" record per
         # processed job block, from whichever worker thread ran it
         self.flight_recorder = options._make_recorder(self._telemetry_tag)
+        self._report_path = options.report_path
         self._count_lock = threading.Lock()
         self._state_count_shared = 0
         self._stop = threading.Event()
@@ -113,11 +114,19 @@ class WorkerPoolChecker(Checker):
                     continue
             self._check_block(pending)
             if self.flight_recorder is not None:
+                # queue = REMAINING market blocks (not the block just
+                # processed).  busy=False opts out of the zero-novelty
+                # stall heuristic: pool job blocks carry un-deduped
+                # successors, so an all-duplicates tail block is a normal
+                # converging run, not wavefront-style spinning (the
+                # wavefront queue holds only unique rows, where zero
+                # fresh inserts IS stall-shaped)
                 self.flight_recorder.step(
                     engine=self._telemetry_tag,
                     states=self._state_count_shared,
                     unique=self.unique_state_count(),
-                    queue=len(pending),
+                    queue=len(self._market.jobs),
+                    busy=False,
                 )
             if self._deadline is not None and time.monotonic() > self._deadline:
                 # "timed out" means CUT SHORT: a run whose last block
@@ -161,6 +170,13 @@ class WorkerPoolChecker(Checker):
             t.join()
         if self._error is not None:
             raise self._error
+        if self.flight_recorder is not None:
+            # close the health timeline (telemetry/health.py): idempotent,
+            # so repeated join() calls emit at most one "done" record.
+            # A deadline-cut run stopped without finishing — its phase
+            # stays where the run actually was.
+            self.flight_recorder.close_run(done=not self._timed_out)
+        self._maybe_write_report()
         return self
 
     def is_done(self) -> bool:

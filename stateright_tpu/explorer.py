@@ -13,9 +13,13 @@ HTTP surface mirrors the reference server (``src/checker/explorer.rs``):
    "as it may be useful for debugging" (``explorer.rs:199-232``); unknown
    fingerprints give 404 (``explorer.rs:233-237``).
  - ``GET /.metrics`` — live flight-recorder telemetry (beyond the
-   reference): ``{summary, series, occupancy, counters}`` for runs spawned
-   with ``.telemetry()`` (``stateright_tpu/telemetry/``); 404 otherwise.
-   The UI draws throughput/occupancy sparklines from it.
+   reference): ``{summary, series, occupancy, counters, health,
+   cartography}`` for runs spawned with ``.telemetry()``
+   (``stateright_tpu/telemetry/``); telemetry off returns a stable JSON
+   error body ``{"error": "telemetry_disabled", "hint": ...}`` with 404.
+   The UI draws throughput/occupancy sparklines and the cartography
+   panel (depth/action histograms, property tallies, shard loads) from
+   it.
  - ``GET /`` — the bundled single-page UI (``ui/``; ours, not the
    reference's).
 
@@ -163,8 +167,12 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
 def _metrics_view(checker) -> Optional[dict]:
     """``GET /.metrics``: the run's flight-recorder telemetry
     (``stateright_tpu/telemetry/``) — summary + the recent per-step series
-    the UI sparklines draw.  None (-> 404) when the run was spawned without
-    ``.telemetry()``: the endpoint never fabricates numbers."""
+    the UI sparklines draw, the live health snapshot
+    (``telemetry/health.py``), and the search-cartography block
+    (``ops/cartography.py``; null unless the run was spawned with
+    ``.telemetry(cartography=True)``).  None (-> the stable
+    ``telemetry_disabled`` error body) when the run has no recorder: the
+    endpoint never fabricates numbers."""
     rec = getattr(checker, "flight_recorder", None)
     if rec is None:
         return None
@@ -188,6 +196,8 @@ def _metrics_view(checker) -> Optional[dict]:
         "series": series,
         "occupancy": occ[-1] if occ else None,
         "counters": rec.counters(),
+        "health": rec.health(),
+        "cartography": rec.cartography(),
     }
 
 
@@ -285,10 +295,17 @@ def _make_handler(model, checker, snapshot: _Snapshot):
             if path == "/.metrics":
                 view = _metrics_view(checker)
                 if view is None:
+                    # STABLE machine-readable body (tooling keys on
+                    # ``error``, humans read ``hint``): telemetry off is an
+                    # expected state, not a routing failure — downstream
+                    # pollers must be able to distinguish it from a typo'd
+                    # URL without parsing prose
                     self._send_json(
                         {
-                            "error": "telemetry not enabled for this run "
-                            "(spawn with .telemetry())"
+                            "error": "telemetry_disabled",
+                            "hint": "spawn the run with .telemetry() "
+                            "(add cartography=True for the search "
+                            "counters) to enable /.metrics",
                         },
                         404,
                     )

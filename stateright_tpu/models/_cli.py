@@ -40,6 +40,7 @@ def run_cli(
     audit: Optional[Callable[[list], None]] = None,
     profile: Optional[Callable[[list], None]] = None,
     sanitize: Optional[Callable[[list], None]] = None,
+    report: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -65,12 +66,15 @@ def run_cli(
         profile(rest)
     elif cmd == "sanitize" and sanitize is not None:
         sanitize(rest)
+    elif cmd == "report" and report is not None:
+        report(rest)
     else:
         print("USAGE:")
         print(usage)
         if check_tpu is not None:
             print("  device verbs also take --checked, --prewarm, "
-                  "--prededup, --compile-cache=DIR (docs/perf.md)")
+                  "--prededup, --compile-cache=DIR (docs/perf.md) and "
+                  "--watch (live status line, docs/telemetry.md)")
         if audit is not None:
             print("  <example> audit    # static preflight audit "
                   "(docs/analysis.md)")
@@ -80,6 +84,9 @@ def run_cli(
         if profile is not None:
             print("  <example> profile [--out=F] [--chrome=F] [ARGS]  "
                   "# telemetry run (docs/telemetry.md)")
+        if report is not None:
+            print("  <example> report [--out=F] [ARGS]  "
+                  "# post-run report: JSON + markdown (docs/telemetry.md)")
 
 
 def pop_checked(rest: list) -> tuple:
@@ -123,6 +130,101 @@ def apply_perf(builder, cfg: dict):
     if cfg.get("compile_cache"):
         builder = builder.compile_cache(cfg["compile_cache"])
     return builder
+
+
+# -- live watch view (--watch on the device verbs) ---------------------------
+
+
+def pop_watch(rest: list) -> tuple:
+    """Strip ``--watch`` from a verb's arguments: ``(watch, rest)``.
+    Apply with :func:`apply_watch` + :func:`watch_checker`."""
+    rest = list(rest)
+    watch = "--watch" in rest
+    while "--watch" in rest:
+        rest.remove("--watch")
+    return watch, rest
+
+
+def apply_watch(builder, watch: bool):
+    """Arm a builder for the live watch view: the status line reads the
+    health model and cartography block, so ``--watch`` implies
+    ``.telemetry(cartography=True)`` (docs/telemetry.md)."""
+    if not watch:
+        return builder
+    return builder.cartography()
+
+
+def watch_line(checker) -> str:
+    """One live status line: depth, cumulative counters, smoothed
+    throughput, table load, health phase (+ stall flag), drain ETA."""
+    rec = checker.flight_recorder
+    h = rec.health() if rec is not None else {}
+    last = (rec.last_step() if rec is not None else None) or {}
+    sps = h.get("ewma_states_per_sec")
+    load = last.get("load_factor")
+    depth = last.get("depth", checker.max_depth())
+    parts = [
+        f"depth={depth}",
+        f"states={checker.state_count()}",
+        f"unique={checker.unique_state_count()}",
+        f"states/s={sps if sps is not None else '-'}",
+        f"load={load if load is not None else '-'}",
+        f"phase={h.get('phase', '-')}",
+    ]
+    if h.get("stalled"):
+        parts.append(f"STALLED({h.get('stall_reason') or '?'})")
+    if h.get("eta_secs") is not None:
+        parts.append(f"eta={h['eta_secs']}s")
+    return " ".join(parts)
+
+
+def watch_checker(
+    checker, stream=None, interval: float = 0.25, plain_every: float = 2.0
+):
+    """Render the live status until the run completes, then one final
+    line.  On a TTY the line rewrites in place (plain ``\\r`` + padding —
+    no ANSI sequences, no dependencies); on a non-TTY stream it degrades
+    to one plain line every ``plain_every`` seconds, so piped/CI output
+    stays readable instead of turning into control-character soup."""
+    import time
+
+    stream = stream or sys.stderr
+    tty = bool(getattr(stream, "isatty", lambda: False)())
+    last_plain = -plain_every  # always emit the first line promptly
+    width = 0
+
+    def put(txt: str, end: str = "") -> None:
+        nonlocal width
+        if tty:
+            pad = " " * max(width - len(txt), 0)
+            stream.write("\r" + txt + pad + end)
+            width = len(txt)
+        else:
+            stream.write(txt + "\n")
+        stream.flush()
+
+    t0 = time.monotonic()
+    while not checker.is_done():
+        now = time.monotonic() - t0
+        if tty:
+            put(watch_line(checker))
+        elif now - last_plain >= plain_every:
+            put(watch_line(checker))
+            last_plain = now
+        time.sleep(interval)
+    put(watch_line(checker), end="\n")
+    return checker
+
+
+def spawn_watched(builder, watch: bool, spawn):
+    """Device-verb helper: ``spawn`` is ``builder -> checker`` (async).
+    With ``watch`` the live view renders until done; either way the
+    joined checker is returned (callers chain ``.report()``)."""
+    builder = apply_watch(builder, watch)
+    checker = spawn(builder)
+    if watch:
+        watch_checker(checker)
+    return checker
 
 
 def default_threads() -> int:
@@ -255,9 +357,12 @@ def fleet_sanitize(names: Optional[list] = None, stream=None) -> int:
 # -- profile verb ------------------------------------------------------------
 
 
-def _split_profile_args(args: list) -> tuple:
-    """``(--out, --chrome, rest)`` from a profile verb's argument list."""
-    out, chrome, rest = "telemetry.jsonl", None, []
+def _split_profile_args(
+    args: list, default_out: str = "telemetry.jsonl"
+) -> tuple:
+    """``(--out, --chrome, rest)`` from a profile/report verb's argument
+    list — the single definition of the ``--out=`` parsing."""
+    out, chrome, rest = default_out, None, []
     for a in args:
         if a.startswith("--out="):
             out = a[len("--out="):]
@@ -359,6 +464,84 @@ def fleet_profile(args: Optional[list] = None, stream=None) -> int:
     return 0
 
 
+# -- report verb -------------------------------------------------------------
+
+
+def _split_report_args(args: list) -> tuple:
+    """``(--out, rest)`` from a report verb's argument list (the profile
+    splitter with the ``--chrome=`` channel discarded)."""
+    out, _chrome, rest = _split_profile_args(
+        args, default_out="run-report.json"
+    )
+    return out, rest
+
+
+def report_models(
+    models: Iterable[tuple], out: str, stream=None
+) -> list:
+    """Run each ``(label, model)`` with cartography-instrumented telemetry
+    and write one post-run report (``telemetry/report.py``: JSON + sibling
+    markdown).  A single configuration writes exactly ``out``; multiple
+    configurations write numbered siblings (``out`` stem + ``-N``).
+    Models without a tensor twin run host BFS — their report simply
+    carries no cartography block.  Returns the written JSON paths."""
+    from ..parallel.tensor_model import twin_or_none
+
+    stream = stream or sys.stdout
+    models = list(models)
+    paths = []
+    for i, (label, model) in enumerate(models):
+        if len(models) == 1:
+            path = out
+        else:
+            stem, ext = os.path.splitext(out)
+            path = f"{stem}-{i}{ext or '.json'}"
+        builder = model.checker().report(path)
+        if twin_or_none(model) is None:
+            print(
+                f"--- {label}: no device twin; reporting a host BFS run "
+                "(no cartography block)", file=stream,
+            )
+            builder.spawn_bfs().join()
+        else:
+            builder.spawn_tpu(sync=True)
+        print(f"--- {label}: report written to {path}", file=stream)
+        paths.append(path)
+    return paths
+
+
+def make_report_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as a ``report``
+    CLI verb (``--out=`` flag, remaining args to the factory)."""
+
+    def _report(rest: list) -> None:
+        out, rest = _split_report_args(rest)
+        report_models(factory(rest), out)
+
+    return _report
+
+
+def fleet_report(args: Optional[list] = None, stream=None) -> int:
+    """``report [MODULE] [--out=F] [ARGS...]``: post-run report for one
+    example module's ``_audit_models`` configurations; 0 on success."""
+    import importlib
+
+    stream = stream or sys.stdout
+    out, rest = _split_report_args(list(args or []))
+    name = rest.pop(0) if rest else "two_phase_commit"
+    try:
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+    except ImportError as e:
+        print(f"report: cannot import models.{name}: {e}", file=stream)
+        return 1
+    factory = getattr(mod, "_audit_models", None)
+    if factory is None:
+        print(f"{name}: no _audit_models hook to report on", file=stream)
+        return 1
+    report_models(factory(rest), out, stream=stream)
+    return 0
+
+
 def fleet_audit(names: Optional[list] = None, stream=None) -> int:
     """Audit the whole example fleet (or just ``names``); 0 iff clean.
     Modules without an ``_audit_models`` hook are reported and skipped."""
@@ -395,6 +578,8 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_sanitize(argv[1:]))
     if argv and argv[0] == "profile":
         raise SystemExit(fleet_profile(argv[1:]))
+    if argv and argv[0] == "report":
+        raise SystemExit(fleet_report(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
@@ -406,6 +591,10 @@ def main(argv: Optional[list] = None) -> None:
           "[--out=F] [--chrome=F] [ARGS...]")
     print("    telemetry-instrumented run; flight-recorder JSONL export "
           "(docs/telemetry.md)")
+    print("  python -m stateright_tpu.models._cli report [MODULE] "
+          "[--out=F] [ARGS...]")
+    print("    post-run report (JSON + markdown): totals, cartography, "
+          "health timeline (docs/telemetry.md)")
 
 
 if __name__ == "__main__":

@@ -30,10 +30,14 @@ from ._cli import (
     apply_perf,
     default_threads,
     make_audit_cmd,
+    make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_watch,
     run_cli,
+    spawn_watched,
 )
 
 HUNGRY, HAS_LEFT, DONE = 0, 1, 2
@@ -165,6 +169,7 @@ def main(argv=None) -> None:
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         n = parse(rest)
         print(
             f"Model checking {n} dining philosophers on the device "
@@ -174,7 +179,10 @@ def main(argv=None) -> None:
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return
-        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
+        spawn_watched(
+            apply_perf(m.checker().checked(checked), perf), watch,
+            lambda b: b.spawn_tpu(),
+        ).report()
 
     def check_auto(rest):
         n = parse(rest)
@@ -196,6 +204,8 @@ def main(argv=None) -> None:
         explore=explore,
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

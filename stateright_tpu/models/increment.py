@@ -15,6 +15,8 @@ from .. import Model, Property
 from ._cli import (
     default_threads,
     make_audit_cmd,
+    make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
     run_cli,
 )
@@ -107,6 +109,8 @@ def main(argv=None):
         explore=explore,
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

@@ -41,10 +41,13 @@ from ._cli import (
     default_threads,
     make_audit_cmd,
     make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_watch,
     run_cli,
+    spawn_watched,
 )
 
 def _ballot_zero() -> tuple:
@@ -307,6 +310,7 @@ def main(argv=None):
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         client_count = int(rest[0]) if rest else 2
         target = int(rest[1]) if len(rest) > 1 else None
         print(
@@ -323,7 +327,7 @@ def main(argv=None):
         b = apply_perf(m.checker().checked(checked), perf)
         if target:
             b = b.target_states(target)
-        b.spawn_tpu().report()
+        spawn_watched(b, watch, lambda b: b.spawn_tpu()).report()
 
     def check_auto(rest):
         client_count = int(rest[0]) if rest else 2
@@ -374,6 +378,7 @@ def main(argv=None):
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
         profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

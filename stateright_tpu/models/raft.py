@@ -38,10 +38,14 @@ from ._cli import (
     apply_perf,
     default_threads,
     make_audit_cmd,
+    make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_watch,
     run_cli,
+    spawn_watched,
 )
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
@@ -242,6 +246,7 @@ def main(argv=None) -> None:
     def check_sym_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         n, network = parse(rest)
         print(
             f"Model checking Raft leader election with {n} servers on the "
@@ -251,13 +256,15 @@ def main(argv=None) -> None:
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check-sym`")
             return
-        apply_perf(
-            m.checker().checked(checked).symmetry(), perf
-        ).spawn_tpu().report()
+        spawn_watched(
+            apply_perf(m.checker().checked(checked).symmetry(), perf),
+            watch, lambda b: b.spawn_tpu(),
+        ).report()
 
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         n, network = parse(rest)
         print(
             f"Model checking Raft leader election with {n} servers on the "
@@ -267,7 +274,10 @@ def main(argv=None) -> None:
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return
-        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
+        spawn_watched(
+            apply_perf(m.checker().checked(checked), perf), watch,
+            lambda b: b.spawn_tpu(),
+        ).report()
 
     def check_auto(rest):
         n, network = parse(rest)
@@ -319,6 +329,8 @@ def main(argv=None) -> None:
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

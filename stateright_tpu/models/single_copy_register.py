@@ -30,10 +30,14 @@ from ._cli import (
     apply_perf,
     default_threads,
     make_audit_cmd,
+    make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_watch,
     run_cli,
+    spawn_watched,
 )
 
 
@@ -118,6 +122,7 @@ def main(argv=None):
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         client_count = int(rest[0]) if rest else 2
         network = (
             Network.from_name(rest[1])
@@ -132,7 +137,10 @@ def main(argv=None):
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return
-        apply_perf(m.checker().checked(checked), perf).spawn_tpu().report()
+        spawn_watched(
+            apply_perf(m.checker().checked(checked), perf), watch,
+            lambda b: b.spawn_tpu(),
+        ).report()
 
     def check_auto(rest):
         client_count = int(rest[0]) if rest else 2
@@ -174,6 +182,8 @@ def main(argv=None):
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

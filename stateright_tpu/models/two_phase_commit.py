@@ -29,10 +29,13 @@ from ._cli import (
     default_threads,
     make_audit_cmd,
     make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_watch,
     run_cli,
+    spawn_watched,
 )
 
 # RM states, ordered so sorting gives a canonical symmetry representative
@@ -398,28 +401,36 @@ def main(argv=None):
     def check_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         rm_count = int(rest[0]) if rest else 2
         print(
             f"Checking two phase commit with {rm_count} RMs on TPU"
             + (" (checked mode)." if checked else ".")
         )
-        apply_perf(
-            TwoPhaseSys(rm_count).checker().checked(checked), perf
-        ).spawn_tpu().report()
+        spawn_watched(
+            apply_perf(
+                TwoPhaseSys(rm_count).checker().checked(checked), perf
+            ),
+            watch, lambda b: b.spawn_tpu(),
+        ).report()
 
     def check_sym_tpu(rest):
         checked, rest = pop_checked(rest)
         perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
         rm_count = int(rest[0]) if rest else 2
         print(
             f"Checking two phase commit with {rm_count} RMs on TPU "
             "using symmetry reduction"
             + (" (checked mode)." if checked else ".")
         )
-        apply_perf(
-            TwoPhaseSys(rm_count).checker().checked(checked).symmetry(),
-            perf,
-        ).spawn_tpu().report()
+        spawn_watched(
+            apply_perf(
+                TwoPhaseSys(rm_count).checker().checked(checked).symmetry(),
+                perf,
+            ),
+            watch, lambda b: b.spawn_tpu(),
+        ).report()
 
     def check_auto(rest):
         rm_count = int(rest[0]) if rest else 2
@@ -453,6 +464,7 @@ def main(argv=None):
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
         profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

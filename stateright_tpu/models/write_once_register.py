@@ -37,10 +37,17 @@ from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, WORegister
 from ..symmetry import RewritePlan, rewrite_value
 from ._cli import (
+    apply_perf,
     default_threads,
     make_audit_cmd,
+    make_profile_cmd,
+    make_report_cmd,
     make_sanitize_cmd,
+    pop_checked,
+    pop_perf,
+    pop_watch,
     run_cli,
+    spawn_watched,
 )
 
 
@@ -159,6 +166,25 @@ def main(argv=None):
             lambda s: server_representative(s, server_count)
         ).spawn_dfs().report()
 
+    def check_tpu(rest):
+        checked, rest = pop_checked(rest)
+        perf, rest = pop_perf(rest)
+        watch, rest = pop_watch(rest)
+        client_count, server_count, network = parse(rest)
+        print(
+            f"Model checking a write-once register with {client_count} "
+            f"clients and {server_count} servers on the device wavefront "
+            "engine."
+        )
+        m = wo_register_model(client_count, server_count, network)
+        if m.tensor_model() is None:
+            print("this configuration has no device twin; use `check` (CPU)")
+            return
+        spawn_watched(
+            apply_perf(m.checker().checked(checked), perf), watch,
+            lambda b: b.spawn_tpu(),
+        ).report()
+
     def check_auto(rest):
         client_count, server_count, network = parse(rest)
         print(
@@ -184,16 +210,20 @@ def main(argv=None):
     run_cli(
         "  write_once_register check [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
         "  write_once_register check-sym [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
+        "  write_once_register check-tpu [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
         "  write_once_register check-auto [CLIENT_COUNT] [SERVER_COUNT] [NETWORK]\n"
         "  write_once_register explore [CLIENT_COUNT] [ADDRESS]\n"
         "  write_once_register spawn",
         check,
         check_sym=check_sym,
+        check_tpu=check_tpu,
         check_auto=check_auto,
         explore=explore,
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
+        profile=make_profile_cmd(_audit_models),
+        report=make_report_cmd(_audit_models),
         argv=argv,
     )
 

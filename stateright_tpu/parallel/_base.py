@@ -122,6 +122,19 @@ class WavefrontChecker(Checker):
         # telemetry never adds device ops (docs/telemetry.md overhead
         # contract); occupancy sampling / profiling are explicit opt-ins.
         self._telemetry_opts = options.telemetry_opts or {}
+        # search cartography (ops/cartography.py, docs/telemetry.md): the
+        # ONE telemetry option that does change the step program — small
+        # on-device reductions riding the packed stats vector.  Off (the
+        # default) keeps the step jaxpr bit-identical (pinned by test).
+        self._cartography = bool(self._telemetry_opts.get("cartography"))
+        # wavefront depth-histogram base: depth lanes banked from the
+        # consumed queue prefixes the growth transform reclaims (the live
+        # histogram is queue-derived; see TpuChecker._grow)
+        self._cart_depth_base = None
+        # post-run report (telemetry/report.py): written once at join()
+        # when the builder requested CheckerBuilder.report(PATH)
+        self._report_path = getattr(options, "report_path", None)
+        self._report_written = False
         tag = "wavefront" if self._engine_tag == "single" else self._engine_tag
         self.flight_recorder = options._make_recorder(tag)
         self._profiler = None
@@ -149,8 +162,9 @@ class WavefrontChecker(Checker):
         # a timer requests a cooperative stop, honored at the next host
         # sync — the run ends cleanly with partial counts and a resumable
         # final snapshot, exactly like stop()
+        self._timed_out = False
         if options.timeout_secs is not None:
-            timer = threading.Timer(options.timeout_secs, self._stop.set)
+            timer = threading.Timer(options.timeout_secs, self._deadline_stop)
             timer.daemon = True
             timer.start()
         self._thread = None
@@ -161,6 +175,7 @@ class WavefrontChecker(Checker):
         self._run_error: Optional[BaseException] = None
         if sync:
             self._run()
+            self._maybe_write_report()
         else:
             self._thread = threading.Thread(
                 target=self._run_guarded, daemon=True
@@ -177,6 +192,20 @@ class WavefrontChecker(Checker):
         except BaseException as e:  # noqa: BLE001 - re-raised at join()
             self._run_error = e
             self._done.set()
+
+    def _deadline_stop(self) -> None:
+        """The builder ``timeout()`` deadline fired: flag the cut (unless
+        the run already finished) and request a cooperative stop."""
+        if not self._done.is_set():
+            self._timed_out = True
+        self._stop.set()
+
+    @property
+    def timed_out(self) -> bool:
+        """True when the builder ``timeout()`` deadline cut the run short
+        (pool-checker parity) — ``is_done()`` only means *stopped*, and
+        the run report must not present a deadline-cut run as complete."""
+        return self._timed_out
 
     def _pre_run_validate(self) -> None:  # engine-specific, optional
         pass
@@ -332,7 +361,20 @@ class WavefrontChecker(Checker):
             self._thread.join()
         if self._run_error is not None:
             raise self._run_error
+        self._maybe_write_report()
         return self
+
+    # _maybe_write_report: inherited from Checker (checker/base.py)
+
+    def cartography(self) -> Optional[dict]:
+        """Latest search-cartography snapshot (``ops/cartography.py``), or
+        None when the run was spawned without
+        ``.telemetry(cartography=True)``.  Mid-run this is the last host
+        sync's counters; after completion, the final (exact) ones."""
+        if self._results and "cartography" in self._results:
+            return dict(self._results["cartography"])
+        live = getattr(self, "_live_cart", None)
+        return dict(live) if live else None
 
     def state_count(self) -> int:
         return self._results["states"] if self._results else 0

@@ -103,6 +103,7 @@ def _build_sharded_run(
     steps: int = 16,
     cand_local: Optional[int] = None,
     prededup: bool = False,
+    cartography: bool = False,
 ):
     """Build the jitted whole-run shard_map for fixed per-device capacities.
 
@@ -117,7 +118,16 @@ def _build_sharded_run(
     owner-side insert width for its copies.  Per-device only: duplicates
     generated on different devices still meet (and dedup) at the owner.
     Counts/traces are bit-identical either way (same contract as the
-    single-device engine; pinned by tests)."""
+    single-device engine; pinned by tests).
+
+    ``cartography`` appends the search counters (``ops/cartography.py``)
+    to the carry: the replicated depth/action/property tallies the
+    single-device engine keeps, PLUS the shard-local extras the
+    multi-chip runs need — per-shard table load and the source→dest
+    routed-candidate matrix (all-to-all volume), from which the host
+    derives the imbalance summary.  Off means the whole program is
+    bit-identical to a pre-cartography build (same contract as
+    ``prededup``)."""
     ndev = mesh.shape[AXIS]
     width, arity = tensor.width, tensor.max_actions
     n_props = len(props)
@@ -142,6 +152,29 @@ def _build_sharded_run(
     def owner_of(fps):
         return ((fps >> jnp.uint64(32)) % jnp.uint64(ndev)).astype(jnp.int32)
 
+    if cartography:
+        from ..ops.cartography import (
+            DEPTH_BINS,
+            action_hist_delta,
+            prop_tally_delta,
+        )
+
+        p_len = max(n_props, 1)
+
+        def cart_init(n_new_g, n_new_local):
+            """Initial counters: replicated depth/action/property tallies
+            plus the shard-local load vector and route matrix (varying)."""
+            return (
+                jnp.zeros((DEPTH_BINS,), jnp.int64)
+                .at[0].set(n_new_g.astype(jnp.int64)),
+                jnp.zeros((max(arity, 1),), jnp.int64),
+                jnp.zeros((p_len,), jnp.int64),
+                jnp.zeros((p_len,), jnp.int64),
+                _to_varying(jnp.zeros((1,), jnp.int64))
+                + n_new_local.astype(jnp.int64)[None],
+                _to_varying(jnp.zeros((1, ndev), jnp.int64)),
+            )
+
     # -- property kernels (cross-device: min-fp witness, deterministic) ------
 
     def record_first(disc, i, hit, fps):
@@ -150,8 +183,7 @@ def _build_sharded_run(
         take = (disc[i] == jnp.uint64(0)) & (glob != EMPTY)
         return disc.at[i].set(jnp.where(take, glob, disc[i]))
 
-    def eval_props(rows, fps, live, ebits, disc):
-        masks = tensor.property_masks(rows)  # [F, P] bool
+    def eval_props(masks, fps, live, ebits, disc):
         for i, p in enumerate(props):
             if p.expectation is Expectation.ALWAYS:
                 disc = record_first(disc, i, live & ~masks[..., i], fps)
@@ -273,6 +305,8 @@ def _build_sharded_run(
                  jnp.int64(n_init),  # state_count counts all inits
                  jnp.zeros((max(n_props, 1),), jnp.uint64),
                  jnp.int32(0), status)
+        if cartography:
+            carry = carry + cart_init(unique, n_new)
         return carry + (keep_going(carry).astype(jnp.int32),)
 
     def keep_going(carry):
@@ -293,9 +327,11 @@ def _build_sharded_run(
 
         def expand(carry):
             (tfp, tpl, rows, fps, ebits, unique, scount, disc, depth,
-             status) = carry
+             status) = carry[:10]
+            cart = carry[10:]
             live = fps != EMPTY
-            ebits, disc = eval_props(rows, fps, live, ebits, disc)
+            masks = tensor.property_masks(rows)  # [F, P] bool
+            ebits, disc = eval_props(masks, fps, live, ebits, disc)
             # Mid-block early exit (reference ``bfs.rs:121-128``): mask the
             # expansion instead of branching so the collective sequence stays
             # uniform across devices.
@@ -369,27 +405,66 @@ def _build_sharded_run(
                     status,
                 )
             depth = depth + jnp.where(n_new_g > 0, 1, 0).astype(jnp.int32)
+            if cartography:
+                (depth_hist, act_hist, p_evals, p_hits, shard_load,
+                 route_mat) = cart
+                # the frontier is one BFS level, so the new ``depth`` IS the
+                # level of this expansion's novel inserts (no-op if none)
+                depth_hist = depth_hist.at[
+                    jnp.clip(depth, 0, DEPTH_BINS - 1)
+                ].add(n_new_g)
+                act_hist = act_hist + jax.lax.psum(
+                    action_hist_delta(valid), AXIS
+                )
+                d_evals, d_hits = prop_tally_delta(live, masks, n_props)
+                p_evals = p_evals + jax.lax.psum(d_evals, AXIS)
+                p_hits = p_hits + jax.lax.psum(d_hits, AXIS)
+                # shard extras stay device-local (varying): per-shard fresh
+                # inserts, and this shard's routed-candidate row (what it
+                # SENT per destination through the all-to-all)
+                shard_load = shard_load + n_new.astype(jnp.int64)[None]
+                cvalid = cand_fp != EMPTY
+                owner = jnp.where(cvalid, owner_of(cand_fp), jnp.int32(ndev))
+                d_route = jnp.zeros((ndev,), jnp.int64).at[owner].add(
+                    jnp.where(cvalid, jnp.int64(1), jnp.int64(0)),
+                    mode="drop",
+                )
+                route_mat = route_mat + d_route[None, :]
+                cart = (depth_hist, act_hist, p_evals, p_hits, shard_load,
+                        route_mat)
             return (tfp, tpl, nrows, nfps, nebt, unique, scount, disc,
-                    depth, status)
+                    depth, status) + tuple(cart)
 
         def body(carry):
             new = expand(carry)
             status = new[9]
             # Atomic step: on overflow nothing advances except the status
             # code, so the host's growth transform resumes from a consistent
-            # carry and the failed wavefront replays losslessly.  (The
-            # visited-table part of the rollback is already guaranteed by
+            # carry and the failed wavefront replays losslessly — the
+            # cartography counters roll back with everything else, so a
+            # replayed wavefront never double-counts.  (The visited-table
+            # part of the rollback is already guaranteed by
             # ``bucket_insert`` writing nothing on overflow.)
             ofl = status != jnp.int32(_OK)
-            rolled = tuple(
-                jnp.where(ofl, old, nxt) for old, nxt in zip(carry[:9], new[:9])
-            )
-            return rolled + (status,)
+            rolled = [
+                jnp.where(ofl, old, nxt) for old, nxt in zip(carry, new)
+            ]
+            rolled[9] = status
+            return tuple(rolled)
 
         # Device-local carry components must enter the loop as "varying" over
         # the mesh axis even when their initial value is a replicated constant
-        # (shard_map's vma typing for while_loop).
-        carry = tuple(_to_varying(x) for x in carry[:5]) + tuple(carry[5:])
+        # (shard_map's vma typing for while_loop).  With cartography the two
+        # shard-local counter buffers (load vector, route matrix) ride at the
+        # carry tail and are varying too.
+        ncarry = len(carry)
+        varying_idx = set(range(5))
+        if cartography:
+            varying_idx |= {ncarry - 2, ncarry - 1}
+        carry = tuple(
+            _to_varying(x) if i in varying_idx else x
+            for i, x in enumerate(carry)
+        )
         _, carry = jax.lax.while_loop(
             lambda s: (s[0] < steps) & keep_going(s[1]),
             lambda s: (s[0] + 1, body(s[1])),
@@ -398,6 +473,9 @@ def _build_sharded_run(
         return carry + (keep_going(carry).astype(jnp.int32),)
 
     in_specs = (P(AXIS),) * 5 + (P(),) * 5
+    if cartography:
+        # replicated depth/action/property tallies + sharded load/route
+        in_specs = in_specs + (P(),) * 4 + (P(AXIS), P(AXIS))
     out_specs = in_specs + (P(),)
     init_fn = jax.jit(
         shard_map(device_init, mesh, in_specs=(), out_specs=out_specs)
@@ -410,7 +488,7 @@ def _build_sharded_run(
         # deserialization path mis-applies donation metadata and returns
         # garbage (see prewarm.donation_supported / docs/perf.md)
         donate_argnums=(
-            tuple(range(10)) if donation_supported() else ()
+            tuple(range(len(in_specs))) if donation_supported() else ()
         ),
     )
     return init_fn, step_fn
@@ -511,6 +589,51 @@ class ShardedTpuChecker(WavefrontChecker):
             self._gather_fn = gather  # one compile serves both tables
         return np.asarray(jax.device_get(gather(sharded)))
 
+    def _cart_zero_host(self) -> list:
+        """Fresh host-side cartography counter buffers in carry-tail order
+        (depth/action/property tallies + per-shard load and route matrix);
+        empty when cartography is off."""
+        if not self._cartography:
+            return []
+        from ..ops.cartography import cart_zero_np
+
+        zeros = cart_zero_np(self.tensor.max_actions, len(self._props))
+        zeros.append(np.zeros((self.ndev,), np.int64))
+        zeros.append(np.zeros((self.ndev, self.ndev), np.int64))
+        return zeros
+
+    def _cart_resume_host(self) -> list:
+        """Cartography counter tail for a resumed carry: the snapshot's
+        stored cumulative counters when present (``cart0``..``cart5``,
+        written by ``_carry_to_snapshot``), zeros for snapshots predating
+        cartography (their histograms then cover post-resume work only —
+        the old behavior, now the fallback instead of the rule)."""
+        zeros = self._cart_zero_host()
+        snap = self._resume if self._resume is not None else {}
+        return [
+            np.asarray(snap[f"cart{i}"]).astype(z.dtype).reshape(z.shape)
+            if f"cart{i}" in snap
+            else z
+            for i, z in enumerate(zeros)
+        ]
+
+    def _sync_cartography(self, arrs, *, states: int, unique: int) -> None:
+        """Assemble the sharded cartography snapshot from the pulled
+        counter buffers (depth, action, prop-evals, prop-hits, per-shard
+        load, route matrix — global views)."""
+        from ..ops.cartography import snapshot
+
+        dh, ah, pe, ph, load, route = arrs
+        snap = snapshot(
+            depth_hist=dh, action_hist=ah, prop_evals=pe, prop_hits=ph,
+            prop_names=[pr.name for pr in self._props],
+            states=states, unique=unique,
+            shard_load=load, route_matrix=route,
+        )
+        self._live_cart = snap
+        if self.flight_recorder is not None:
+            self.flight_recorder.set_cartography(snap)
+
     # -- live progress.  Growth is work-preserving (atomic steps + host-side
     # buffer transforms), so counters are monotone across growth events. ----
 
@@ -578,6 +701,12 @@ class ShardedTpuChecker(WavefrontChecker):
             k: np.asarray(v)
             for k, v in zip(_SHARDED_SNAPSHOT_KEYS, carry)
         }
+        # cartography counter tail (cumulative, in-carry on this engine):
+        # persisted so a resumed run's histograms keep reconciling with
+        # the cumulative totals (sum(depth_hist) == unique) instead of
+        # restarting at zero against a non-zero ``unique``
+        for i, v in enumerate(carry[10:]):
+            snap[f"cart{i}"] = np.asarray(v)
         snap["more"] = int(np.asarray(more))
         snap["ndev"] = self.ndev
         snap["cap_local"] = cap
@@ -688,7 +817,9 @@ class ShardedTpuChecker(WavefrontChecker):
                 (global_rows,) + trailing, shard_sp, bufs
             )
 
-        new = list(carry[:10])
+        # cartography counter buffers (carry tail past the 10 base
+        # elements) are capacity-independent: they pass through untouched
+        new = list(carry)
         if status == _TABLE_OVERFLOW:
             cap2 = cap * 2
             pl_by_dev = {
@@ -783,12 +914,15 @@ class ShardedTpuChecker(WavefrontChecker):
             else:
                 finished = carry0
 
+        # cartography tail: 4 replicated counter buffers + 2 shard-local
+        # ones ride the carry after the 10 base elements (ops/cartography.py)
+        ncarry = 10 + (6 if self._cartography else 0)
         while True:  # one iteration per engine build (growth rebuilds)
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
             cand_local = max(64, cf * fcap)
             sym = self._symmetry is not None
             key = (mesh_key, cap, fcap, bucket_cap, cand_local, self._target,
-                   sym, self._steps, self._prededup)
+                   sym, self._steps, self._prededup, self._cartography)
             fns = cache.get(key)
             if rec is not None and key != getattr(
                 self, "_last_engine_key", None
@@ -815,6 +949,7 @@ class ShardedTpuChecker(WavefrontChecker):
                     self.tensor, self._props, self.mesh, cap, fcap, bucket_cap,
                     self._target, sym=sym, steps=self._steps,
                     cand_local=cand_local, prededup=self._prededup,
+                    cartography=self._cartography,
                 )
                 cache[key] = fns
             init_fn, step_fn = fns
@@ -822,9 +957,18 @@ class ShardedTpuChecker(WavefrontChecker):
             watch = CompileWatch() if rec is not None else None
             t_call = time.monotonic()
             if finished is not None:
-                out = tuple(jnp.asarray(c) for c in finished) + (jnp.int32(0),)
+                out = (
+                    tuple(jnp.asarray(c) for c in finished)
+                    + tuple(jnp.asarray(z) for z in self._cart_resume_host())
+                    + (jnp.int32(0),)
+                )
                 watch = None
             elif pending is not None:
+                if self._cartography and len(pending) == 10:
+                    # re-seed the counter tail from the snapshot's stored
+                    # cumulative counters (zeros only for pre-cartography
+                    # snapshots) so resumed histograms keep reconciling
+                    pending = list(pending) + self._cart_resume_host()
                 out = step_fn(*pending)
                 pending = None
             else:
@@ -834,10 +978,13 @@ class ShardedTpuChecker(WavefrontChecker):
                 # only the replicated scalars cross to the host per sync
                 # (one batched transfer); the sharded carry stays
                 # device-resident between calls
-                carry = out[:10]
-                unique, scount, depth, status, more, disc = jax.device_get(
-                    (out[5], out[6], out[8], out[9], out[10], out[7])
-                )
+                carry = out[:ncarry]
+                pulls = [out[5], out[6], out[8], out[9], out[ncarry], out[7]]
+                if self._cartography:
+                    pulls.extend(out[10:ncarry])
+                got = jax.device_get(tuple(pulls))
+                unique, scount, depth, status, more, disc = got[:6]
+                cart_arrs = got[6:]
                 if rec is not None and watch is not None:
                     # the device_get above blocked on the dispatched block:
                     # dispatch-to-materialize is the real device+compile wall
@@ -869,6 +1016,10 @@ class ShardedTpuChecker(WavefrontChecker):
                 )
                 self._live = (scount, unique, depth)
                 self._live_disc = np.asarray(disc)
+                if self._cartography and cart_arrs:
+                    self._sync_cartography(
+                        cart_arrs, states=scount, unique=unique
+                    )
                 if rec is not None:
                     syncs += 1
                     # the replicated scalars + discovery vector are the
@@ -880,6 +1031,11 @@ class ShardedTpuChecker(WavefrontChecker):
                         depth=depth, status=status,
                         cap=cap * self.ndev, cand=cand_local * self.ndev,
                         load_factor=round(unique / (cap * self.ndev), 6),
+                        # only the keep-going flag crosses to the host, not
+                        # a frontier count: hand the health model liveness
+                        # explicitly so the final zero-novelty sync is
+                        # completion-shaped, never a stall
+                        busy=bool(more),
                     )
                     if occ_every and syncs % occ_every == 0:
                         self._telemetry_occupancy(
@@ -919,6 +1075,12 @@ class ShardedTpuChecker(WavefrontChecker):
                     )
                     if status == _CAND_OVERFLOW:
                         rec.add("compaction_hits")
+                if (
+                    rec is not None
+                    and self._cartography
+                    and getattr(self, "_live_cart", None)
+                ):
+                    rec.record("cartography", at="growth", **self._live_cart)
                 if from_init:
                     # init overflow: nothing ran yet, so a plain re-init at
                     # doubled capacity loses no work (device_init is not
@@ -958,6 +1120,10 @@ class ShardedTpuChecker(WavefrontChecker):
             "table_fp": self._host_table(carry[0]),
             "table_parent": self._host_table(carry[1]),
         }
+        if self._cartography and getattr(self, "_live_cart", None):
+            self._results["cartography"] = self._live_cart
+            if rec is not None:
+                rec.record("cartography", at="final", **self._live_cart)
         if rec is not None:
             # the final tables just crossed to the host for _results —
             # price that pull, then take the closing occupancy sample on
@@ -969,8 +1135,12 @@ class ShardedTpuChecker(WavefrontChecker):
             self._telemetry_occupancy(
                 self._results["table_fp"], at="final", transferred=False
             )
+        if rec is not None:
+            rec.close_run(done=not self._timed_out)
         # keep the final carry device-resident; a stopped run's snapshot
         # keeps more=1 so resume continues it (see _final_snapshot)
+        # full carry (base 10 + cartography counter tail when on): the
+        # final snapshot persists the counters too (_carry_to_snapshot)
         self._final_state = (carry, more, (cap, fcap, bf, cf))
         self._warn_small_space()
         self._done.set()

@@ -37,6 +37,23 @@ import numpy as np
 from ..fingerprint import hash_words
 
 
+def twin_or_none(model):
+    """The model's device twin with host-fallback semantics: None when the
+    model declares no twin OR its construction fails for any reason
+    (CompileError, unsupported config, ...).  Shared by ``spawn_auto`` and
+    the CLI ``report`` fallback; the device spawn path itself resolves the
+    twin directly so construction errors surface there instead."""
+    try:
+        cached = getattr(model, "_tensor_cached", None)
+        return (
+            cached()
+            if cached is not None
+            else getattr(model, "tensor_model", lambda: None)()
+        )
+    except Exception:  # noqa: BLE001 - any twin failure: host fallback
+        return None
+
+
 class TensorModel:
     """Base class for device twins of object-form models."""
 

@@ -99,6 +99,11 @@ _HEAD, _TAIL, _UNIQUE, _SCOUNT, _DISC, _MAXDEPTH, _STATUS = (
 # (snapshots zip against _SNAPSHOT_KEYS and so deliberately drop it — a
 # resumed checked run re-seeds an all-clear error)
 _ERR = 13
+# cartography mode only: the search counters (ops/cartography.py — action
+# histogram + per-property tallies; the depth histogram is queue-derived
+# at sync time, never carried) ride the carry tail AFTER the checked
+# error flag; snapshots drop them too (per-step tallies restart at a
+# resume boundary, like the error flag re-seed)
 
 _SNAPSHOT_KEYS = (
     "table_fp", "table_parent", "q_rows", "q_fp", "q_ebits",
@@ -113,19 +118,32 @@ _ST_DISC = 6
 _STATS_CARRY_ORDER = (_HEAD, _TAIL, _UNIQUE, _SCOUNT, _MAXDEPTH, _STATUS)
 
 
-def _stats_np(carry) -> np.ndarray:
-    """Host-side equivalent of the jitted ``stats_of`` (same layout)."""
-    return np.asarray(
-        [np.asarray(carry[i]) for i in _STATS_CARRY_ORDER]
-        + list(np.asarray(carry[_DISC])),
-        dtype=np.uint64,
+def _stats_np(carry, cart_start: Optional[int] = None) -> np.ndarray:
+    """Host-side equivalent of the jitted ``stats_of`` (same layout).
+    ``cart_start`` appends the cartography section: the queue-derived
+    depth histogram first, then the counter buffers (carry tail from that
+    index on), exactly as the device ``stats_of`` does."""
+    vals = [np.asarray(carry[i]) for i in _STATS_CARRY_ORDER] + list(
+        np.asarray(carry[_DISC])
     )
+    if cart_start is not None:
+        from ..ops.cartography import queue_depth_hist_np
+
+        vals.extend(
+            queue_depth_hist_np(
+                np.asarray(carry[_QDEPTH]), int(np.asarray(carry[_TAIL]))
+            )
+        )
+        for arr in carry[cart_start:]:
+            vals.extend(np.asarray(arr).reshape(-1))
+    return np.asarray(vals, dtype=np.uint64)
 
 
 def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
                   sym: bool = False, cand: Optional[int] = None,
-                  checked: bool = False, prededup: bool = False):
+                  checked: bool = False, prededup: bool = False,
+                  cartography: bool = False):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -185,6 +203,20 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # reconstructs the full message anyway
         checked_kernels = checkify_kernels(tensor)
 
+    # search-cartography counters (ops/cartography.py): carry tail AFTER
+    # the checked error flag — action histogram + property tallies only;
+    # the depth histogram is queue-derived at sync time (queue_depth_hist),
+    # so the per-step cost stays at two small column-sums.  Off means zero
+    # extra ops in the step jaxpr (same contract as
+    # telemetry/checked/prededup, pinned by test)
+    cart_start = (_ERR + 1) if checked else _ERR
+    if cartography:
+        from ..ops.cartography import (
+            action_hist_delta,
+            prop_tally_delta,
+            queue_depth_hist,
+        )
+
     def record_first(disc, i, hit, fps):
         """First-wins discovery of property ``i`` at the first hit row."""
         fp = fps[jnp.argmax(hit)]
@@ -222,12 +254,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
 
     def step(carry):
         """Pop one batch, expand, dedup+insert, append novel rows."""
+        (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+         unique, scount, disc, maxdepth, status) = carry[:_ERR]
         if checked:
-            (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
-             unique, scount, disc, maxdepth, status, err) = carry
-        else:
-            (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
-             unique, scount, disc, maxdepth, status) = carry
+            err = carry[_ERR]
+        cart = carry[cart_start:]
         n_avail = tail - head
         rows = jax.lax.dynamic_slice(qrows, (head, jnp.int32(0)), (batch, width))
         fps = jax.lax.dynamic_slice(qfp, (head,), (batch,))
@@ -314,6 +345,21 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         scount = jnp.where(
             overflow, scount, scount + jnp.sum(valid, dtype=jnp.int64)
         )
+        if cartography:
+            # same replay discipline as scount: an overflowed batch counts
+            # nothing so the post-growth replay is the only count.  (The
+            # depth histogram needs no guard at all: it is derived from the
+            # queue at sync time, and an overflowed insert appended
+            # nothing.)
+            act_hist, p_evals, p_hits = cart
+            zero = jnp.int64(0)
+            act_hist = act_hist + jnp.where(
+                overflow, zero, action_hist_delta(valid)
+            )
+            d_evals, d_hits = prop_tally_delta(live, masks, n_props)
+            p_evals = p_evals + jnp.where(overflow, zero, d_evals)
+            p_hits = p_hits + jnp.where(overflow, zero, d_hits)
+            cart = (act_hist, p_evals, p_hits)
         # Clean-boundary growth triggers: past these thresholds the host
         # grows buffers and resumes (table target load ≤ 25%: the Poisson
         # bucket-overflow tail stays negligible).
@@ -336,11 +382,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                 jnp.int32(_STATUS_POISON),
                 status,
             )
+        out = (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+               unique, scount, disc, maxdepth, status)
         if checked:
-            return (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
-                    unique, scount, disc, maxdepth, status, err)
-        return (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
-                unique, scount, disc, maxdepth, status)
+            out = out + (err,)
+        return out + tuple(cart)
 
     def cond(state):
         k, carry = state
@@ -357,12 +403,24 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         """Pack every scalar the host loop reads into one small vector so a
         host sync costs a single device round-trip (the tunnel RTT to a
         remote TPU dwarfs the transfer itself).  Layout: ``_ST_*``."""
-        return jnp.concatenate([
+        parts = [
             jnp.stack(
                 [carry[i].astype(jnp.uint64) for i in _STATS_CARRY_ORDER]
             ),
             carry[_DISC],
-        ])
+        ]
+        if cartography:
+            # the counters ride the SAME packed vector: cartography never
+            # adds a second host round-trip per sync.  The depth histogram
+            # is derived HERE — once per sync, from the depth-sorted queue
+            # (every fresh insert ever made sits in qdepth[:tail]) — so
+            # the per-step program pays nothing for it
+            parts.append(
+                queue_depth_hist(carry[_QDEPTH], carry[_TAIL])
+                .astype(jnp.uint64)
+            )
+            parts += [c.astype(jnp.uint64) for c in carry[cart_start:]]
+        return jnp.concatenate(parts)
 
     def _run_impl(carry):
         _, carry = jax.lax.while_loop(
@@ -425,6 +483,15 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                  status)
         if checked:
             carry = carry + (jnp.bool_(False),)
+        if cartography:
+            # per-step tallies start at zero; the depth histogram is not
+            # carried — the init states' depth-0 lanes already sit in
+            # qdepth[:n_new], where stats_of derives the histogram
+            carry = carry + (
+                jnp.zeros((max(arity, 1),), jnp.int64),
+                jnp.zeros((max(n_props, 1),), jnp.int64),
+                jnp.zeros((max(n_props, 1),), jnp.int64),
+            )
         return carry, stats_of(carry)
 
     return init_fn, run_fn
@@ -443,7 +510,7 @@ def _repad_queue(carry_np: list, qalloc: int) -> None:
 
 
 def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
-                 checked: bool) -> tuple:
+                 checked: bool, cartography: bool = False) -> tuple:
     """Abstract carry signature of the engine built for these capacities —
     what ahead-of-time compilation (``run_fn.lower(avals).compile()``)
     needs instead of concrete arrays.  Must mirror ``init_fn``'s output
@@ -465,6 +532,12 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
     )
     if checked:
         avals = avals + (sds((), jnp.bool_),)
+    if cartography:
+        from ..ops.cartography import cart_carry_shapes
+
+        avals = avals + tuple(
+            sds(s, jnp.int64) for s in cart_carry_shapes(arity, n_props)
+        )
     return avals
 
 
@@ -556,7 +629,7 @@ class TpuChecker(WavefrontChecker):
     def _engine_key(self, cap, qcap, batch, cand) -> tuple:
         return (cap, qcap, batch, cand, self._steps, self._target,
                 self._pallas, self._symmetry is not None, self._checked,
-                self._prededup)
+                self._prededup, self._cartography)
 
     def _build(self, cap, qcap, batch, cand):
         return _build_engine(
@@ -564,7 +637,42 @@ class TpuChecker(WavefrontChecker):
             self._target, pallas=self._pallas,
             sym=self._symmetry is not None, cand=cand,
             checked=self._checked, prededup=self._prededup,
+            cartography=self._cartography,
         )
+
+    @property
+    def _cart_start(self) -> int:
+        """Carry index where the cartography counter tail begins."""
+        return (_ERR + 1) if self._checked else _ERR
+
+    def _sync_cartography(self, tail, *, states: int, unique: int) -> None:
+        """Parse the cartography section of the packed stats vector (the
+        part after the discovery fps) into the live snapshot, and hand it
+        to the flight recorder when one is attached."""
+        from ..ops.cartography import DEPTH_BINS, snapshot
+
+        arity = max(self.tensor.max_actions, 1)
+        p = max(len(self._props), 1)
+        o = 0
+        dh = np.asarray(tail[o:o + DEPTH_BINS]).astype(np.int64)
+        if self._cart_depth_base is not None:
+            # growth reclaimed queue prefixes: their banked depth lanes
+            # complete the queue-derived histogram (see _grow)
+            dh = dh + self._cart_depth_base
+        o += DEPTH_BINS
+        ah = tail[o:o + arity]
+        o += arity
+        pe = tail[o:o + p]
+        o += p
+        ph = tail[o:o + p]
+        snap = snapshot(
+            depth_hist=dh, action_hist=ah, prop_evals=pe, prop_hits=ph,
+            prop_names=[pr.name for pr in self._props],
+            states=states, unique=unique,
+        )
+        self._live_cart = snap
+        if self.flight_recorder is not None:
+            self.flight_recorder.set_cartography(snap)
 
     def _engine(self, cap, qcap, batch, cand, kind: str = "growth"):
         """The compiled engine for these capacities, through (in order) the
@@ -667,6 +775,7 @@ class TpuChecker(WavefrontChecker):
             if key in cache or self._prewarmer.scheduled(key):
                 continue
             checked, n_props = self._checked, len(self._props)
+            cartography = self._cartography
             tensor = self.tensor
 
             def build(ncap=ncap, nqcap=nqcap, ncand=ncand):
@@ -674,7 +783,7 @@ class TpuChecker(WavefrontChecker):
                 exe = _aot_compile(
                     run_fn,
                     _carry_avals(tensor, n_props, ncap, nqcap, batch,
-                                 checked),
+                                 checked, cartography),
                 )
                 return init_fn, exe
             if self._prewarmer.schedule(key, build):
@@ -716,6 +825,11 @@ class TpuChecker(WavefrontChecker):
         snap["width"] = self.tensor.width
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
+        if self._cart_depth_base is not None:
+            # depth lanes banked by growth compactions (_grow): without
+            # them a resumed histogram forgets every state popped before
+            # a pre-snapshot growth, breaking sum(depth_hist) == unique
+            snap["cart_depth_base"] = self._cart_depth_base.copy()
         return snap
 
     def _pre_run_validate(self) -> None:
@@ -729,14 +843,16 @@ class TpuChecker(WavefrontChecker):
         self._batch = int(snap.get("batch", self._batch))
         self._cand = int(snap.get("cand", self._cand))
         qalloc = qcap + self._batch * self.tensor.max_actions
+        base = snap.get("cart_depth_base")
+        if base is not None:
+            self._cart_depth_base = np.asarray(base, np.int64).copy()
         carry = [np.asarray(snap[k]) for k in _SNAPSHOT_KEYS]
         # snapshots may have been taken at a different qalloc; re-pad
         _repad_queue(carry, qalloc)
         return cap, qcap, [jnp.asarray(c) for c in carry]
 
-    @staticmethod
-    def _grow(carry_np: list, cap: int, qcap: int, batch: int, arity: int,
-              status: int, cand: int):
+    def _grow(self, carry_np: list, cap: int, qcap: int, batch: int,
+              arity: int, status: int, cand: int):
         """Grow whatever is (near) full; returns (cap, qcap, carry).
 
         Both conditions are always re-checked regardless of which status code
@@ -765,6 +881,19 @@ class TpuChecker(WavefrontChecker):
             carry_np[_TFP], carry_np[_TPL] = tfp, tpl
         head, tail = int(carry_np[_HEAD]), int(carry_np[_TAIL])
         pending = tail - head
+        if self._cartography and head > 0:
+            # the compaction below drops the consumed queue prefix — bank
+            # its depth lanes first, or the queue-derived histogram
+            # (ops/cartography.queue_depth_hist) would forget every state
+            # popped before this growth.  Free: the carry is already on
+            # the host here.
+            from ..ops.cartography import DEPTH_BINS, queue_depth_hist_np
+
+            if self._cart_depth_base is None:
+                self._cart_depth_base = np.zeros(DEPTH_BINS, np.int64)
+            self._cart_depth_base += queue_depth_hist_np(
+                carry_np[_QDEPTH], head
+            )
         # reclaim the consumed prefix; grow only if still needed
         for i in (_QROWS, _QFP, _QEBITS, _QDEPTH):
             carry_np[i] = carry_np[i][head:tail].copy()
@@ -856,6 +985,17 @@ class TpuChecker(WavefrontChecker):
             if self._checked:
                 # snapshots never carry the error flag: re-seed all-clear
                 carry = list(carry) + [jnp.bool_(False)]
+            if self._cartography:
+                # snapshots never carry the counters either: a resumed run
+                # restarts its per-step tallies at zero (totals keep
+                # counting, and the depth histogram — queue-derived — comes
+                # back COMPLETE, since the snapshot kept the queue)
+                from ..ops.cartography import cart_carry_shapes
+
+                carry = list(carry) + [
+                    jnp.zeros(s, jnp.int64)
+                    for s in cart_carry_shapes(arity, len(self._props))
+                ]
         else:
             while True:
                 init_fn, _ = self._engine(cap, qcap, batch, cand,
@@ -878,6 +1018,8 @@ class TpuChecker(WavefrontChecker):
         rec = self.flight_recorder
         occ_every = int(self._telemetry_opts.get("occupancy_every") or 0)
         syncs = 0
+        disc_len = max(len(self._props), 1)
+        cart_start = self._cart_start if self._cartography else None
         if rec is not None:
             rec.update_meta(
                 batch=batch, steps_per_call=self._steps, pallas=self._pallas,
@@ -885,16 +1027,20 @@ class TpuChecker(WavefrontChecker):
         while True:
             # one host sync per iteration: the packed stats vector
             if stats is None:
-                stats = _stats_np(carry)
+                stats = _stats_np(carry, cart_start)
             head, tail, unique, scount, maxdepth, status = (
                 int(stats[_ST_HEAD]), int(stats[_ST_TAIL]),
                 int(stats[_ST_UNIQUE]), int(stats[_ST_SCOUNT]),
                 int(stats[_ST_MAXDEPTH]), int(stats[_ST_STATUS]),
             )
-            disc = stats[_ST_DISC:]
+            disc = stats[_ST_DISC:_ST_DISC + disc_len]
             with self._live_lock:
                 self._live = (scount, unique, maxdepth)
                 self._live_disc = np.asarray(disc)
+            if self._cartography:
+                self._sync_cartography(
+                    stats[_ST_DISC + disc_len:], states=scount, unique=unique
+                )
             if self._checked and len(carry) > _ERR:
                 # a failed kernel check raises HERE, before any growth or
                 # checkpoint handling touches the (possibly garbage) carry
@@ -943,11 +1089,19 @@ class TpuChecker(WavefrontChecker):
                     )
                     if status == _STATUS_CAND_FULL:
                         rec.add("compaction_hits")
-                # the checkify Error pytree (checked mode) is not a numpy
-                # buffer: strip it around host-side growth and re-seed
-                # all-clear after (the error check above already passed)
-                err_tail = carry[_ERR:] if self._checked else []
-                carry = carry[:_ERR] if self._checked else carry
+                    if self._cartography and getattr(self, "_live_cart", None):
+                        # growth boundaries are the cartography time series:
+                        # one ring record each (plus the closing "final")
+                        rec.record(
+                            "cartography", at="growth", **self._live_cart
+                        )
+                # the carry TAIL (checked error flag, cartography counters)
+                # is not part of the growth transform: strip it around the
+                # host-side growth and re-attach unchanged after (the error
+                # check above already passed; the counters are
+                # capacity-independent)
+                tail_extra = list(carry[_ERR:])
+                carry = list(carry[:_ERR])
                 if status == _STATUS_CAND_FULL:
                     # the candidate budget is an engine parameter, not a
                     # carry buffer: double it, clear the carry's status word
@@ -961,7 +1115,7 @@ class TpuChecker(WavefrontChecker):
                             batch, arity, _STATUS_TABLE_FULL, cand,
                         )
                         carry = [jnp.asarray(c) for c in carry_np]
-                    carry = list(carry) + err_tail
+                    carry = list(carry) + tail_extra
                     self._stage("growth", time.monotonic() - t_grow)
                     stats = None
                     continue
@@ -982,7 +1136,7 @@ class TpuChecker(WavefrontChecker):
                     rec.add_bytes(
                         h2d=sum(a.nbytes for a in carry_np if a.ndim)
                     )
-                carry = [jnp.asarray(c) for c in carry_np] + err_tail
+                carry = [jnp.asarray(c) for c in carry_np] + tail_extra
                 self._stage("growth", time.monotonic() - t_grow)
                 stats = None
                 continue
@@ -1021,6 +1175,14 @@ class TpuChecker(WavefrontChecker):
             "disc": np.asarray(disc),
             "depth": maxdepth,
         }
+        if self._cartography and getattr(self, "_live_cart", None):
+            self._results["cartography"] = self._live_cart
+            if rec is not None:
+                rec.record("cartography", at="final", **self._live_cart)
+        if rec is not None:
+            # a deadline-cut run stopped; it did not finish — leave the
+            # health phase where the run actually was
+            rec.close_run(done=not self._timed_out)
         self._warn_small_space()
         self._done.set()
 

@@ -34,5 +34,11 @@ jaxpr and enabling it costs <3% wall time (asserted in
 
 from .recorder import FlightRecorder, STATUS_NAMES
 from .profile import ScopedProfiler
+from .health import HealthTracker
 
-__all__ = ["FlightRecorder", "ScopedProfiler", "STATUS_NAMES"]
+__all__ = [
+    "FlightRecorder",
+    "HealthTracker",
+    "ScopedProfiler",
+    "STATUS_NAMES",
+]

@@ -20,9 +20,16 @@ import json
 from .recorder import FlightRecorder
 
 
+# JSONL export schema version: the golden-schema test
+# (tests/test_telemetry_schema.py) pins field names/types per record kind
+# against this number — bump it when the schema changes shape.
+SCHEMA_V = 1
+
+
 def to_jsonl(rec: FlightRecorder, path, append: bool = False) -> None:
     header = {
         "kind": "header",
+        "v": SCHEMA_V,
         "meta": rec.meta_snapshot(),
         "capacity": rec.capacity,
         "summary": rec.summary(),
@@ -45,6 +52,13 @@ def from_jsonl(path) -> FlightRecorder:
     design."""
     rec = None
     headers = []
+
+    def replaying(r):
+        # exported health events replay verbatim; replayed steps must not
+        # REgenerate them (the ring would then carry each event twice)
+        r._replaying = True
+        return r
+
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -54,10 +68,10 @@ def from_jsonl(path) -> FlightRecorder:
             if obj.get("kind") == "header":
                 headers.append(obj)
                 if rec is None:
-                    rec = FlightRecorder(
+                    rec = replaying(FlightRecorder(
                         capacity=int(obj.get("capacity", 4096)),
                         meta=obj.get("meta") or {},
-                    )
+                    ))
                 else:
                     rec.update_meta(**(obj.get("meta") or {}))
                     # run boundary in an appended file: the next run's
@@ -69,7 +83,7 @@ def from_jsonl(path) -> FlightRecorder:
                     rec.add(k, v)
                 continue
             if rec is None:  # record lines before any header: tolerate
-                rec = FlightRecorder()
+                rec = replaying(FlightRecorder())
             kind = obj.get("kind", "note")
             fields = {
                 k: v for k, v in obj.items() if k not in ("seq", "t", "kind")
@@ -86,6 +100,7 @@ def from_jsonl(path) -> FlightRecorder:
         return FlightRecorder()
     if len(headers) == 1:
         rec._reconcile_totals(headers[0].get("summary") or {})
+    rec._replaying = False
     return rec
 
 

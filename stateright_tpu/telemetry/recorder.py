@@ -6,7 +6,7 @@ Record schema (every record):
    is how many old records the ring evicted)
  - ``t``    — seconds since the recorder was created (monotonic clock)
  - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
-   | ``"profile"`` | ``"note"``
+   | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"note"``
 
 ``step`` records additionally carry the engine tag and cumulative counters
 (``states``, ``unique``) plus derived per-step deltas (``d_states``,
@@ -29,6 +29,8 @@ import threading
 import time
 from collections import deque
 from typing import Optional
+
+from .health import HealthTracker
 
 # Growth-record status vocabulary across engines.  Each engine maps its
 # own numeric status words onto these names NEXT TO its constant
@@ -64,27 +66,47 @@ class FlightRecorder:
         self._kind_counts: dict[str, int] = {}
         # last step snapshot for delta derivation: (t, states, unique)
         self._last_step: Optional[tuple] = None
+        # the full last step record, for O(1) live readers (--watch polls
+        # several times a second; scanning the ring would hold the lock
+        # across a list copy of up to ``capacity`` dicts each poll)
+        self._last_step_rec: Optional[dict] = None
         # wall-clock origin for summary(): recorder creation (t=0), so
         # work done before the FIRST step record (init + first compiled
         # block) is not silently excluded from the throughput denominator.
         # JSONL replay shifts it to reproduce the exported wall time.
         self._t_offset = 0.0
+        # progress/health model (health.py): fed by every step record;
+        # phase/stall TRANSITIONS are emitted back into the ring as
+        # ``health`` records.  JSONL replay suppresses regeneration (the
+        # exported events replay verbatim instead).
+        self._health = HealthTracker()
+        self._replaying = False
+        # latest search-cartography snapshot (ops/cartography.py); lives
+        # OUTSIDE the ring like the aggregate counters, so eviction never
+        # loses it.  The engines refresh it per host sync.
+        self._cartography: Optional[dict] = None
 
     # -- recording -----------------------------------------------------------
+
+    def _append_unlocked(
+        self, kind: str, fields: dict, t: Optional[float] = None
+    ) -> dict:
+        """Append one record; caller holds the lock."""
+        self._seq += 1
+        self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
+        rec = {
+            "seq": self._seq,
+            "t": round(self._now() if t is None else t, 6),
+            "kind": kind,
+            **fields,
+        }
+        self._records.append(rec)
+        return rec
 
     def record(self, kind: str, *, t: Optional[float] = None, **fields) -> dict:
         """Append one record; returns it (the stored dict)."""
         with self._lock:
-            self._seq += 1
-            self._kind_counts[kind] = self._kind_counts.get(kind, 0) + 1
-            rec = {
-                "seq": self._seq,
-                "t": round(self._now() if t is None else t, 6),
-                "kind": kind,
-                **fields,
-            }
-            self._records.append(rec)
-            return rec
+            return self._append_unlocked(kind, fields, t)
 
     def step(self, *, engine: str, states: int, unique: int,
              t: Optional[float] = None, **fields) -> dict:
@@ -110,24 +132,31 @@ class FlightRecorder:
             d_states = states - prev_states
             d_unique = unique - prev_unique
             self._last_step = (now, states, unique)
-            self._seq += 1
-            self._kind_counts["step"] = self._kind_counts.get("step", 0) + 1
-            rec = {
-                "seq": self._seq,
-                "t": round(now, 6),
-                "kind": "step",
-                "engine": engine,
-                "dt": round(max(now - prev_t, 0.0), 6),
-                "states": int(states),
-                "unique": int(unique),
-                "d_states": int(d_states),
-                "d_unique": int(d_unique),
-                "dedup": (
-                    round(1.0 - d_unique / d_states, 6) if d_states > 0 else 0.0
-                ),
-                **fields,
-            }
-            self._records.append(rec)
+            self._last_step_rec = rec = self._append_unlocked(
+                "step",
+                {
+                    "engine": engine,
+                    "dt": round(max(now - prev_t, 0.0), 6),
+                    "states": int(states),
+                    "unique": int(unique),
+                    "d_states": int(d_states),
+                    "d_unique": int(d_unique),
+                    "dedup": (
+                        round(1.0 - d_unique / d_states, 6)
+                        if d_states > 0
+                        else 0.0
+                    ),
+                    **fields,
+                },
+                t=now,
+            )
+            if not self._replaying:
+                # the health model rides the step stream; transitions
+                # (phase change, stall start/end) become ``health`` records
+                # so exports carry the timeline.  Replays skip this — the
+                # exported events come back verbatim instead.
+                for ev in self._health.update(rec):
+                    self._append_unlocked("health", ev, t=now)
             return rec
 
     def add(self, counter: str, n: float = 1) -> None:
@@ -150,6 +179,35 @@ class FlightRecorder:
             self.add("h2d_bytes", int(h2d))
         if d2h:
             self.add("d2h_bytes", int(d2h))
+
+    def set_cartography(self, snap: dict) -> None:
+        """Replace the latest search-cartography snapshot (the engines
+        call this once per host sync with cumulative counters)."""
+        with self._lock:
+            self._cartography = dict(snap)
+
+    def cartography(self) -> Optional[dict]:
+        """Latest search-cartography snapshot, or None when the run was
+        spawned without ``.telemetry(cartography=True)``."""
+        with self._lock:
+            return dict(self._cartography) if self._cartography else None
+
+    def health(self) -> dict:
+        """Live progress/health snapshot (health.py): phase, stall flag,
+        novelty rate, EWMA throughput, drain ETA."""
+        with self._lock:
+            return self._health.snapshot()
+
+    def close_run(self, done: bool = True) -> None:
+        """Mark the run finished: the health phase transitions to ``done``
+        (emitting the closing ``health`` record)."""
+        if not done:
+            return
+        with self._lock:
+            if self._replaying:
+                return
+            for ev in self._health.mark_done():
+                self._append_unlocked("health", ev)
 
     def update_meta(self, **fields) -> None:
         """Locked meta mutation (engines annotate run config mid-run while
@@ -179,6 +237,20 @@ class FlightRecorder:
         if kind is not None:
             recs = [r for r in recs if r["kind"] == kind]
         return recs
+
+    def kind_count(self, kind: str) -> int:
+        """TOTAL records of ``kind`` ever appended — unlike ``records()``,
+        this survives ring eviction (the ring is a window, the counts are
+        the truth).  Consumers compare it against ``len(records(kind))``
+        to detect a truncated window (telemetry/report.py)."""
+        with self._lock:
+            return int(self._kind_counts.get(kind, 0))
+
+    def last_step(self) -> Optional[dict]:
+        """The most recent step record (a copy), without scanning the
+        ring — the ``--watch`` line polls this several times a second."""
+        with self._lock:
+            return dict(self._last_step_rec) if self._last_step_rec else None
 
     def __len__(self) -> int:
         with self._lock:
@@ -235,6 +307,9 @@ class FlightRecorder:
             last_step = self._last_step
             t_offset = self._t_offset
             meta = dict(self.meta)
+            cartography = (
+                dict(self._cartography) if self._cartography else None
+            )
         occ = [r for r in recs if r["kind"] == "occupancy"]
         out: dict = {
             **meta,
@@ -267,6 +342,8 @@ class FlightRecorder:
         stages = self.stages()
         if stages is not None:
             out["stages"] = stages
+        if cartography is not None:
+            out["cartography"] = cartography
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")
@@ -292,6 +369,8 @@ class FlightRecorder:
                     self._kind_counts[kind] = max(
                         self._kind_counts.get(kind, 0), int(summary[key])
                     )
+            if summary.get("cartography") and self._cartography is None:
+                self._cartography = dict(summary["cartography"])
             if summary.get("states") is not None and self._last_step:
                 last_t = self._last_step[0]
                 self._last_step = (

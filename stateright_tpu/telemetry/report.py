@@ -1,0 +1,300 @@
+"""Post-run report: the artifact a human reads after an unattended run.
+
+``write_report(checker, path)`` renders one completed check into a JSON
+document at ``path`` plus a sibling markdown rendering (``path`` with the
+extension swapped for ``.md``) — combining the run totals, the search
+cartography (``ops/cartography.py``), the deterministic health timeline
+(``health.phase_timeline``), growth events, and the model's audit /
+sanitizer status.  Wired as ``CheckerBuilder.report(PATH)`` (written at
+the first ``join()`` after completion), the per-example ``report`` CLI
+verb, and ``bench.py``'s paxos-3 / 2pc-7 legs; gated by
+``regress.py --cartography``.
+
+Determinism contract (pinned by ``tests/test_cartography.py``): for a
+fixed model/config the JSON body is byte-stable across runs — every field
+is count-derived (state totals, histograms, phase transitions at step
+granularity, growth capacity ladders), and the single volatile field is
+the ``generated_at`` header stamped at write time.  Wall-clock data
+(stage attribution, throughput, EWMA series) varies run to run and lives
+in the MARKDOWN rendering only, clearly sectioned as non-deterministic.
+
+Schema versioning: ``v`` (:data:`REPORT_V`) at the top level; the
+embedded cartography block carries its own ``v``
+(``ops.cartography.CARTOGRAPHY_V``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .health import phase_timeline
+
+REPORT_V = 1
+
+# growth-record fields that are count-derived (the record's ``t``/``seq``
+# are wall-clock/ordering bookkeeping and stay out of the report body)
+_GROWTH_KEYS = ("status", "unique", "cap", "qcap", "cand", "fcap", "bucket")
+
+
+def _expectation_name(prop) -> str:
+    # Expectation is a proper enum; its .name is ALWAYS/SOMETIMES/...
+    return getattr(prop.expectation, "name", str(prop.expectation)).lower()
+
+
+def build_report(checker) -> dict:
+    """The deterministic report body (no ``generated_at``; JSON-safe).
+
+    Works on any completed checker; sections appear only when their data
+    source exists (cartography needs ``.telemetry(cartography=True)``,
+    growth/health need a flight recorder, audit needs a preflight run)."""
+    model = checker.model
+    props = list(model.properties())
+    disc = checker.discoveries()
+    tag = getattr(checker, "_engine_tag", None)
+    if tag == "single":
+        tag = "wavefront"  # the recorder's naming (parallel/_base.py)
+    # is_done() means STOPPED, not "space exhausted": a deadline-cut run
+    # is done-in-that-sense but incomplete, and the report is exactly the
+    # artifact that must not claim otherwise
+    timed_out = bool(getattr(checker, "timed_out", False))
+    done = checker.is_done() and not timed_out
+    totals = {
+        "states": checker.state_count(),
+        "unique": checker.unique_state_count(),
+        "max_depth": getattr(checker, "max_depth", lambda: None)(),
+        "done": done,
+    }
+    if timed_out:
+        totals["timed_out"] = True
+    out: dict = {
+        "v": REPORT_V,
+        "model": type(model).__name__,
+        "engine": tag or type(checker).__name__,
+        "totals": totals,
+        "properties": [
+            {
+                "name": p.name,
+                "expectation": _expectation_name(p),
+                "discovery": p.name in disc,
+            }
+            for p in props
+        ],
+    }
+    cart = None
+    if hasattr(checker, "cartography"):
+        cart = checker.cartography()
+    if cart is not None:
+        out["cartography"] = cart
+    rec = getattr(checker, "flight_recorder", None)
+    if rec is not None:
+        growth = []
+        for r in rec.records("growth"):
+            growth.append(
+                {k: r[k] for k in _GROWTH_KEYS if k in r}
+            )
+        out["growth_events"] = growth
+        if rec.kind_count("growth") > len(growth):
+            out["growth_events_truncated"] = True
+        # the COUNT-derived health replay (health.py separates this from
+        # the wall-clock EWMA/ETA signals, which never enter the report).
+        # The ring is a bounded window: a run with more syncs than the
+        # telemetry capacity loses its earliest steps, and a timeline
+        # replayed from a mid-run prefix misclassifies phases (the true
+        # peak is gone) — flag it instead of silently presenting the
+        # window as the whole run.
+        steps = rec.records("step")
+        out["health_timeline"] = phase_timeline(steps)
+        if rec.kind_count("step") > len(steps):
+            out["health_timeline_truncated"] = True
+        out["final_phase"] = (
+            "done" if done else rec.health().get("phase")
+        )
+    audit = getattr(model, "_audit_report", None)
+    if audit is not None:
+        out["audit"] = {
+            "ok": audit.ok,
+            "errors": len(audit.errors),
+            "warnings": len(audit.warnings),
+            "rules": sorted({f.rule_id for f in audit.findings}),
+        }
+        sanitizer = (audit.metrics or {}).get("sanitizer")
+        if sanitizer is not None:
+            out["sanitizer"] = {
+                k: sanitizer.get(k)
+                for k in ("sites", "proved", "undecided", "rules")
+            }
+            out["sanitizer"]["checked_run"] = bool(
+                getattr(checker, "_checked", False)
+            )
+    return out
+
+
+def _bar(n: int, peak: int, width: int = 30) -> str:
+    if peak <= 0:
+        return ""
+    return "#" * max(1 if n else 0, round(width * n / peak))
+
+
+def _hist_lines(values, label_of) -> list:
+    peak = max(values) if values else 0
+    return [
+        f"  {label_of(i):>12}  {v:>10}  {_bar(v, peak)}"
+        for i, v in enumerate(values)
+    ]
+
+
+def render_markdown(report: dict, rec=None) -> str:
+    """Human rendering of a report body.  ``rec`` (the run's live
+    FlightRecorder) adds the WALL-CLOCK section — stage attribution and
+    throughput — which is deliberately absent from the JSON body (it
+    varies run to run; docs/telemetry.md "Reading a run report")."""
+    t = report.get("totals", {})
+    lines = [
+        f"# Run report — {report.get('model')} ({report.get('engine')})",
+        "",
+        f"- states generated: **{t.get('states')}**",
+        f"- unique states: **{t.get('unique')}**",
+        f"- max depth: **{t.get('max_depth')}**",
+        f"- completed: **{t.get('done')}**"
+        + (" (cut short by the run deadline)" if t.get("timed_out") else ""),
+        "",
+        "## Properties",
+        "",
+    ]
+    for p in report.get("properties", []):
+        verdict = (
+            "discovery found" if p["discovery"] else "no discovery"
+        )
+        lines.append(f"- `{p['name']}` ({p['expectation']}): {verdict}")
+    cart = report.get("cartography")
+    if cart:
+        lines += ["", "## Search cartography", "", "Depth histogram "
+                  "(fresh inserts per BFS depth):", "```"]
+        lines += _hist_lines(cart.get("depth_hist", []), lambda i: f"d={i}")
+        lines += ["```", "", "Action histogram (successors generated per "
+                  "action slot):", "```"]
+        lines += _hist_lines(
+            cart.get("action_hist", []), lambda i: f"a{i}"
+        )
+        lines += ["```", ""]
+        lines.append(
+            f"- fresh inserts: {cart.get('fresh_inserts')}  /  "
+            f"duplicate hits: {cart.get('duplicate_hits')}"
+        )
+        for p in cart.get("props", []):
+            lines.append(
+                f"- property `{p['name']}`: evaluated {p['evaluated']} "
+                f"rows, condition held on {p['condition_hits']}"
+            )
+        imb = cart.get("shard_imbalance")
+        if imb:
+            lines.append(
+                f"- shard imbalance: max={imb['max']} mean={imb['mean']} "
+                f"ratio={imb['ratio']} (1.0 = balanced)"
+            )
+        if cart.get("routed_candidates") is not None:
+            lines.append(
+                f"- all-to-all routed candidates: "
+                f"{cart['routed_candidates']}"
+            )
+    timeline = report.get("health_timeline")
+    if timeline:
+        lines += ["", "## Health timeline (count-derived)", ""]
+        if report.get("health_timeline_truncated"):
+            lines.append(
+                "- **truncated**: the run outlived the telemetry ring; "
+                "this timeline starts mid-run (raise "
+                "`.telemetry(capacity=...)` for the full series)"
+            )
+        prev = None
+        for e in timeline:
+            if e["phase"] != prev:
+                lines.append(
+                    f"- step {e['step']}: phase `{e['phase']}` "
+                    f"(unique={e['unique']}, novelty={e['novelty']})"
+                )
+                prev = e["phase"]
+        lines.append(f"- final phase: `{report.get('final_phase')}`")
+    growth = report.get("growth_events")
+    if growth is not None:
+        lines += ["", "## Growth events", ""]
+        if report.get("growth_events_truncated"):
+            lines.append("- **truncated**: earliest growths evicted "
+                         "from the telemetry ring")
+        if not growth:
+            lines.append("- none (buffers pre-sized for the space)")
+        for g in growth:
+            caps = ", ".join(
+                f"{k}={v}" for k, v in g.items()
+                if k not in ("status", "unique")
+            )
+            lines.append(
+                f"- `{g.get('status')}` at unique={g.get('unique')} "
+                f"({caps})"
+            )
+    audit = report.get("audit")
+    if audit:
+        lines += ["", "## Audit / sanitizer", "",
+                  f"- audit: {'CLEAN' if audit['ok'] else 'ERRORS'} "
+                  f"({audit['errors']} error(s), {audit['warnings']} "
+                  f"warning(s); rules: "
+                  f"{', '.join(audit['rules']) or 'none'})"]
+        san = report.get("sanitizer")
+        if san:
+            lines.append(
+                f"- sanitizer: {san.get('sites')} indexed site(s), "
+                f"{san.get('proved')} proved in range, "
+                f"{san.get('undecided')} undecided; checked run: "
+                f"{san.get('checked_run')}"
+            )
+    if rec is not None:
+        # everything below varies run to run — markdown only, never JSON
+        lines += ["", "## Wall clock (non-deterministic)", ""]
+        summary = rec.summary()
+        if summary.get("wall_secs") is not None:
+            lines.append(f"- wall: {summary['wall_secs']}s")
+        if summary.get("states_per_sec") is not None:
+            lines.append(
+                f"- throughput: {summary['states_per_sec']} states/s"
+            )
+        stages = rec.stages()
+        if stages:
+            for k, v in stages.items():
+                lines.append(f"- {k}: {v}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_report(checker, path: str) -> dict:
+    """Render ``checker`` into ``path`` (JSON) + the sibling markdown.
+
+    Returns the deterministic body (without the ``generated_at`` header
+    stamped into the file).  The JSON is written with sorted keys OFF —
+    insertion order is part of the pinned byte layout — and a trailing
+    newline."""
+    if os.path.splitext(path)[1] == ".md":
+        # The markdown sibling is derived by swapping the extension; a .md
+        # target would collapse both renderings onto one file and the JSON
+        # body would be silently overwritten.
+        raise ValueError(
+            f"report path {path!r} ends in .md — pass the JSON path; the "
+            "markdown rendering lands next to it as <path-stem>.md"
+        )
+    body = build_report(checker)
+    import datetime
+
+    doc = {
+        "generated_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        **body,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    md_path = os.path.splitext(path)[0] + ".md"
+    rec = getattr(checker, "flight_recorder", None)
+    with open(md_path, "w") as f:
+        f.write(render_markdown(body, rec=rec))
+    return body

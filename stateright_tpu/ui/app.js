@@ -103,6 +103,80 @@ const fmtRate = (v) =>
   : v >= 1e3 ? (v / 1e3).toFixed(1) + "k/s"
   : v.toFixed(0) + "/s";
 
+// Bar chart for the cartography histograms (depth / action counts): same
+// 300x40 frame as the sparklines, one rect per bin.
+function barchart(svg, values) {
+  svg.innerHTML = "";
+  if (!values || !values.length) return 0;
+  const W = 300, H = 40, PAD = 2;
+  const peak = Math.max(...values, 1);
+  const bw = (W - 2 * PAD) / values.length;
+  values.forEach((v, i) => {
+    const h = (v / peak) * (H - 2 * PAD);
+    const r = document.createElementNS("http://www.w3.org/2000/svg", "rect");
+    r.setAttribute("x", PAD + i * bw + 0.5);
+    r.setAttribute("y", H - PAD - h);
+    r.setAttribute("width", Math.max(bw - 1, 1));
+    r.setAttribute("height", Math.max(h, v > 0 ? 1 : 0));
+    r.setAttribute("class", "hist-bar");
+    const title = document.createElementNS("http://www.w3.org/2000/svg", "title");
+    title.textContent = "#" + i + ": " + v.toLocaleString();
+    r.appendChild(title);
+    svg.appendChild(r);
+  });
+  return values.reduce((a, b) => a + b, 0);
+}
+
+function renderCartography(cart) {
+  if (!cart) {
+    $("cartography").hidden = true;
+    return;
+  }
+  $("cartography").hidden = false;
+  const dn = barchart($("hist-depth"), cart.depth_hist);
+  $("cart-depth-n").textContent = "· " + dn.toLocaleString() + " fresh";
+  const an = barchart($("hist-action"), cart.action_hist);
+  $("cart-action-n").textContent = "· " + an.toLocaleString() + " generated";
+  const ul = $("cart-props");
+  ul.innerHTML = "";
+  for (const p of cart.props || []) {
+    const li = document.createElement("li");
+    li.textContent =
+      p.name + ": " + p.evaluated.toLocaleString() + " evaluated, " +
+      p.condition_hits.toLocaleString() + " hits";
+    ul.appendChild(li);
+  }
+  const bits = [
+    "fresh=" + cart.fresh_inserts.toLocaleString(),
+    "dup=" + cart.duplicate_hits.toLocaleString(),
+  ];
+  if (cart.shard_imbalance)
+    bits.push(
+      "shards max/mean=" + cart.shard_imbalance.ratio +
+      " (max=" + cart.shard_imbalance.max + ")"
+    );
+  if (cart.routed_candidates !== undefined)
+    bits.push("routed=" + cart.routed_candidates.toLocaleString());
+  $("cart-summary").textContent = bits.join("  ");
+}
+
+function renderHealth(h) {
+  const el = $("health-line");
+  if (!h) {
+    el.hidden = true;
+    return;
+  }
+  el.hidden = false;
+  const bits = ["phase=" + h.phase];
+  if (h.stalled) bits.push("STALLED (" + (h.stall_reason || "?") + ")");
+  if (h.novelty !== null && h.novelty !== undefined)
+    bits.push("novelty=" + h.novelty);
+  if (h.eta_secs !== null && h.eta_secs !== undefined)
+    bits.push("eta=" + h.eta_secs + "s");
+  el.textContent = bits.join("  ");
+  el.className = h.stalled ? "health stalled" : "health";
+}
+
 async function pollMetrics() {
   if (metricsAvailable === false) return;
   try {
@@ -132,6 +206,8 @@ async function pollMetrics() {
         " full=" + m.occupancy.full_buckets
       );
     $("tele-summary").textContent = bits.join("  ") || "—";
+    renderHealth(m.health);
+    renderCartography(m.cartography);
   } catch (e) {
     /* transient; retry next poll */
   }

@@ -231,3 +231,74 @@ def test_stages_section_gates_fresh_runs_only(tmp_path, capsys):
     rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
                  "tpu_paxos3_stages": stages}, "--stages")
     assert rc == 0 and v["stages"]["baseline"] == stages
+
+
+def test_cartography_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--cartography: a FRESH run must carry a well-formed, reconciling
+    cartography block; stored baselines without one (pre-cartography
+    rounds) never trip the gate, and staleness still wins with exit 2 —
+    the exact --stages rule applied to the search-shape artifact."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # note: baseline has no block
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    cart = {
+        "v": 1,
+        "depth_hist": [1, 10, 29],
+        "action_hist": [5, 20, 15],
+        "props": [],
+        "fresh_inserts": 40,
+        "duplicate_hits": 12,
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_paxos3_unique": 40, "tpu_paxos3_cartography": cart}
+    # fresh + well-formed block -> ok; absent baseline is informational
+    rc, v = run(good, "--cartography")
+    assert rc == 0 and v["ok"] is True
+    assert v["cartography"]["ok"] is True
+    assert v["cartography"]["baseline_present"] is False
+    assert v["cartography"]["summary"]["fresh_inserts"] == 40
+    # fresh but NO block -> exit 1, named in the verdict
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0},
+                "--cartography")
+    assert rc == 1 and v["cartography"]["ok"] is False
+    # malformed: depth histogram does not reconcile with fresh_inserts
+    rc, v = run({**good,
+                 "tpu_paxos3_cartography": {**cart, "fresh_inserts": 99},
+                 "tpu_paxos3_unique": 99}, "--cartography")
+    assert rc == 1
+    assert any("sum(depth_hist)" in p
+               for p in v["cartography"]["problems"])
+    # malformed: block disagrees with the run's own headline unique
+    rc, v = run({**good, "tpu_paxos3_unique": 41}, "--cartography")
+    assert rc == 1
+    assert any("tpu_paxos3_unique" in p
+               for p in v["cartography"]["problems"])
+    # unversioned block -> exit 1
+    rc, v = run({**good,
+                 "tpu_paxos3_cartography": {
+                     k: x for k, x in cart.items() if k != "v"
+                 }}, "--cartography")
+    assert rc == 1
+    assert any("schema version" in p for p in v["cartography"]["problems"])
+    # stale run: staleness exits 2 regardless of cartography
+    rc, v = run({"fresh": False}, "--cartography")
+    assert rc == 2
+    # --allow-stale: a stored pre-cartography artifact is reported, not
+    # gated
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0},
+                "--cartography", "--allow-stale")
+    assert rc == 0 and v["cartography"]["ok"] is False
+    # baseline WITH a block is noted for comparison
+    base.write_text(json.dumps({**BASELINE,
+                                "tpu_paxos3_cartography": cart}))
+    rc, v = run(good, "--cartography")
+    assert rc == 0 and v["cartography"]["baseline_present"] is True

@@ -388,7 +388,10 @@ def test_explorer_metrics_endpoint_shape():
     )
     try:
         m = _get(server.addr, "/.metrics")
-        assert sorted(m) == ["counters", "occupancy", "series", "summary"]
+        assert sorted(m) == [
+            "cartography", "counters", "health", "occupancy", "series",
+            "summary",
+        ]
         series = m["series"]
         assert sorted(series) == [
             "dedup", "load_factor", "states_per_sec", "t", "unique"
@@ -398,13 +401,44 @@ def test_explorer_metrics_endpoint_shape():
         assert all(len(series[k]) == n for k in series)
         assert m["summary"]["unique"] == 288
         assert m["occupancy"]["occupied"] == 288
+        # metrics-on, cartography-off: the block is an explicit null (the
+        # run was spawned without cartography=True), never fabricated
+        assert m["cartography"] is None
+        # the health snapshot is always present with telemetry on
+        assert m["health"]["phase"] == "done"
+        assert m["health"]["stalled"] is False
         # /.status still works alongside
         assert _get(server.addr, "/.status")["unique_state_count"] == 288
     finally:
         server.shutdown()
 
 
+def test_explorer_metrics_with_cartography():
+    """/.metrics with the search counters on: the cartography block is
+    populated and reconciles with the run totals."""
+    from stateright_tpu.explorer import serve
+
+    server = serve(
+        TwoPhaseSys(3).checker().telemetry(cartography=True),
+        "localhost:0", block=False, strategy="tpu", sync=True,
+        capacity=1 << 12, batch=64,
+    )
+    try:
+        m = _get(server.addr, "/.metrics")
+        cart = m["cartography"]
+        assert cart is not None and cart["v"] == 1
+        assert cart["fresh_inserts"] == 288
+        assert sum(cart["depth_hist"]) == 288
+        assert [p["name"] for p in cart["props"]] == [
+            "abort agreement", "commit agreement", "consistent"
+        ]
+    finally:
+        server.shutdown()
+
+
 def test_explorer_metrics_404_without_telemetry():
+    """Telemetry off: a STABLE machine-readable error body, not bare 404
+    prose (downstream pollers key on the ``error`` field)."""
     from stateright_tpu.explorer import serve
 
     server = serve(TwoPhaseSys(3).checker(), "localhost:0", block=False)
@@ -414,6 +448,7 @@ def test_explorer_metrics_404_without_telemetry():
             _get(server.addr, "/.metrics")
         assert exc.value.code == 404
         body = json.loads(exc.value.read())
-        assert "telemetry not enabled" in body["error"]
+        assert body["error"] == "telemetry_disabled"
+        assert ".telemetry()" in body["hint"]
     finally:
         server.shutdown()

@@ -1,0 +1,186 @@
+"""Golden schema for the flight-recorder JSONL export.
+
+Downstream tooling (regress.py, the report renderer, the driver's
+artifact parsers, external dashboards) reads these records by field name.
+This test pins the export schema — field names AND types, per record
+kind — so exporter drift breaks HERE instead of in a consumer three
+rounds later.  The schema is versioned: the JSONL header carries
+``v`` (:data:`stateright_tpu.telemetry.export.SCHEMA_V`); bump it (and
+this golden) together when the shape legitimately changes.
+
+The rule per kind: required fields must all be present with the pinned
+types; any OTHER field must be in the kind's allowed-optional set —
+an unknown field is drift, not decoration.  ``note`` records are the
+explicit free-form escape hatch and are exempt.
+"""
+
+import json
+import numbers
+
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.telemetry.export import SCHEMA_V
+
+# (required, optional) field -> type per record kind.  ``numbers.Real``
+# covers int-or-float counters; bool is pinned apart from int where the
+# distinction carries meaning (cache_hit, stalled).
+_REAL = numbers.Real
+SCHEMA = {
+    "step": (
+        {
+            "engine": str, "dt": _REAL, "states": int, "unique": int,
+            "d_states": int, "d_unique": int, "dedup": _REAL,
+        },
+        {
+            # engine-specific annotations: wavefront/sharded add device
+            # capacities + table load, mp adds round/frontier, pool adds
+            # its work-queue length
+            "depth": int, "status": _REAL, "queue": int, "cap": int,
+            "cand": int, "load_factor": _REAL, "frontier": int,
+            "round": int,
+            # sharded: explicit liveness for the health model's stall
+            # guard (no frontier count crosses to the host there)
+            "busy": bool,
+        },
+    ),
+    "growth": (
+        {"status": str},
+        {"unique": int, "cap": int, "qcap": int, "cand": int,
+         "from_init": bool},
+    ),
+    "occupancy": (
+        {
+            "at": str, "nbuckets": int, "slots_per_bucket": int,
+            "occupied": int, "load_factor": _REAL, "mean_bucket": _REAL,
+            "max_bucket": int, "full_buckets": int,
+            "poisson_full_expect": _REAL, "histogram": list,
+        },
+        {},
+    ),
+    "compile": (
+        {"rung": str, "source": str, "cache_hit": bool,
+         "duration": _REAL},
+        {"cap": int, "qcap": int, "batch": int, "cand": int, "fcap": int,
+         "bucket_cap": int, "prewarm_ready": bool, "build_secs": _REAL},
+    ),
+    "profile": (
+        {"event": str},
+        {"logdir": str, "steps": int, "error": str, "detail": str},
+    ),
+    "health": (
+        {"v": int, "event": str},
+        {"phase": str, "reason": str},
+    ),
+    "cartography": (
+        {
+            "v": int, "at": str, "depth_hist": list, "action_hist": list,
+            "props": list, "fresh_inserts": int, "duplicate_hits": int,
+        },
+        {"shard_load": list, "shard_imbalance": dict,
+         "route_matrix": list, "routed_candidates": int},
+    ),
+}
+_ENVELOPE = {"seq": int, "t": _REAL, "kind": str}
+
+
+def _check_record(rec: dict) -> list:
+    problems = []
+    for k, t in _ENVELOPE.items():
+        if not isinstance(rec.get(k), t):
+            problems.append(f"envelope field {k} missing/mistyped: {rec}")
+    kind = rec.get("kind")
+    if kind == "note":
+        return problems  # free-form by design
+    if kind not in SCHEMA:
+        return problems + [f"unknown record kind {kind!r}: {rec}"]
+    required, optional = SCHEMA[kind]
+    body = {k: v for k, v in rec.items() if k not in _ENVELOPE}
+    for k, t in required.items():
+        if k not in body:
+            problems.append(f"{kind}: missing required field {k}")
+        elif isinstance(body[k], bool) and t is not bool:
+            problems.append(f"{kind}.{k}: bool where {t} pinned")
+        elif not isinstance(body[k], t):
+            problems.append(
+                f"{kind}.{k}: {type(body[k]).__name__} != pinned "
+                f"{getattr(t, '__name__', t)}"
+            )
+    for k, v in body.items():
+        if k in required:
+            continue
+        if k not in optional:
+            problems.append(
+                f"{kind}: UNKNOWN field {k!r} (drift — add it to the "
+                "golden schema deliberately, with its consumer)"
+            )
+        elif v is not None and not isinstance(v, optional[k]):
+            problems.append(
+                f"{kind}.{k}: {type(v).__name__} != pinned "
+                f"{getattr(optional[k], '__name__', optional[k])}"
+            )
+    return problems
+
+
+def _export_lines(tmp_path, builder, **spawn_kw):
+    c = builder.spawn_tpu(sync=True, **spawn_kw)
+    path = tmp_path / "export.jsonl"
+    c.flight_recorder.to_jsonl(path)
+    return [json.loads(ln) for ln in path.read_text().splitlines() if ln]
+
+
+def test_jsonl_header_is_versioned(tmp_path):
+    lines = _export_lines(
+        tmp_path,
+        TwoPhaseSys(3).checker().telemetry(),
+        capacity=1 << 12, batch=64,
+    )
+    header = lines[0]
+    assert header["kind"] == "header"
+    assert header["v"] == SCHEMA_V == 1
+    assert isinstance(header["meta"], dict)
+    assert isinstance(header["capacity"], int)
+    assert isinstance(header["summary"], dict)
+
+
+def test_every_exported_record_matches_the_golden_schema(tmp_path):
+    """One run exercising every record kind the wavefront engine can emit
+    (steps, growth, occupancy, compile, health, cartography), validated
+    field-by-field against the pinned schema."""
+    lines = _export_lines(
+        tmp_path,
+        TwoPhaseSys(5).checker().telemetry(
+            occupancy_every=2, cartography=True
+        ),
+        capacity=1 << 10, batch=256,  # tiny: forces growth events
+    )
+    records = [ln for ln in lines if ln.get("kind") != "header"]
+    kinds = {r["kind"] for r in records}
+    for expect in ("step", "growth", "occupancy", "compile", "health",
+                   "cartography"):
+        assert expect in kinds, f"run did not exercise {expect!r} records"
+    problems = []
+    for r in records:
+        problems += _check_record(r)
+    assert not problems, "\n".join(problems)
+
+
+def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
+    """The summary's embedded cartography block is the same shape as the
+    ring records minus the envelope/at: consumers share one parser."""
+    lines = _export_lines(
+        tmp_path,
+        TwoPhaseSys(3).checker().telemetry(cartography=True),
+        capacity=1 << 12, batch=64,
+    )
+    cart = lines[0]["summary"]["cartography"]
+    required, optional = SCHEMA["cartography"]
+    for k in required:
+        if k == "at":
+            continue  # summary holds the latest snapshot, not a series
+        assert k in cart, f"summary cartography missing {k}"
+    for k in cart:
+        assert k in required or k in optional
+    props = cart["props"]
+    assert all(
+        sorted(p) == ["condition_hits", "evaluated", "name"]
+        for p in props
+    )
