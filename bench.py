@@ -672,6 +672,38 @@ def tpu_phase() -> dict:
         )
     _persist(out)
 
+    # flag-gated POR leg (BENCH_POR=1; docs/analysis.md "State-space
+    # reduction"): the same paxos-3 prefix with partial-order reduction
+    # requested.  The independence analysis conservatively marks the
+    # slot-multiset paxos twin all-dependent (JX302), so this leg measures
+    # the FALLBACK contract — identical counts, and the por_status block
+    # records why no reduction applied.  On a model that does reduce, the
+    # same keys carry the reduced-vs-full split.
+    if os.environ.get("BENCH_POR", "") == "1":
+        try:
+            _mark("compile (paxos3 por engine)")
+            b_por = m3.checker().por()
+            if target:
+                b_por = b_por.target_states(int(target))
+            tpu_por, dt_por = timed(
+                lambda: b_por.spawn_tpu(sync=True, **caps)
+            )
+            out["tpu_paxos3_por_states_per_sec"] = round(
+                tpu_por.state_count() / dt_por, 1
+            )
+            out["tpu_paxos3_por_unique"] = tpu_por.unique_state_count()
+            out["tpu_paxos3_por_sec"] = round(dt_por, 3)
+            out["tpu_paxos3_por"] = tpu_por.por_status()
+            if tpu_por.unique_state_count() != tpu_p3.unique_state_count():
+                out["tpu_paxos3_por_note"] = (
+                    "MISMATCH vs the full-expansion run — investigate"
+                )
+            _mark("paxos3 por leg done")
+        except Exception as e:  # noqa: BLE001 - the flag-gated leg must
+            # never void the primary metric
+            out["tpu_paxos3_por_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
     # remaining parity gate + the driver metric's second config, 2pc check 4
     # AS WRITTEN (it is too small to rate-limit a TPU — ~2k unique states
     # finish in one engine call — so the rate mostly measures fixed per-run

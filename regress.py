@@ -7,7 +7,7 @@ staleness and regressions LOUD:
 
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
-                      [--stages] [--cartography]
+                      [--stages] [--cartography] [--independence]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -112,6 +112,60 @@ def sanitizer_verdict(fleet=None) -> dict:
     }
 
 
+def independence_verdict(run: dict, fleet=None) -> dict:
+    """``--independence``: the static-independence section
+    (docs/analysis.md JX3xx).
+
+    Runs the fleet independence gate (every bundled example must produce
+    a well-formed conflict matrix with no ERROR-level finding — the same
+    contract as the CI verb), and, when the run artifact carries a
+    flag-gated POR leg (``tpu_paxos3_por``), checks it is well-formed: a
+    dict with an ``enabled`` bool, plus matching unique counts when both
+    legs ran (POR must never change counts on paxos — its matrix is
+    conservatively all-dependent).  Stale/pre-POR baselines never gate
+    (the ``--sanitize``/``--cartography`` rule); ``fleet`` overrides the
+    runner for tests."""
+    import io
+
+    if fleet is None:
+        from stateright_tpu.models._cli import fleet_independence as fleet
+    buf = io.StringIO()
+    try:
+        rc = fleet(stream=buf)
+    except Exception as e:  # noqa: BLE001 - an import/trace crash is a
+        # gate failure, not a gate skip
+        return {"clean": False, "error": f"{type(e).__name__}: {e}"}
+    tail = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    out = {"clean": rc == 0, "verdict": tail[-1] if tail else ""}
+    leg_error = run.get("tpu_paxos3_por_error")
+    if leg_error:
+        # a POR leg that crashed is a gate failure, not a gate skip —
+        # the same discipline as the fleet-runner crash above
+        out["clean"] = False
+        out["por_leg"] = {"ok": False, "problems": [f"leg crashed: {leg_error}"]}
+        return out
+    leg = run.get("tpu_paxos3_por")
+    if leg is not None:
+        problems = []
+        if not isinstance(leg, dict) or "enabled" not in leg:
+            problems.append("tpu_paxos3_por block malformed")
+        u_por = run.get("tpu_paxos3_por_unique")
+        u_full = run.get("tpu_paxos3_unique")
+        if (
+            isinstance(u_por, int) and isinstance(u_full, int)
+            and u_por != u_full
+        ):
+            problems.append(
+                f"por unique {u_por} != full unique {u_full} "
+                "(paxos must not reduce: all-dependent matrix)"
+            )
+        out["por_leg"] = {"ok": not problems}
+        if problems:
+            out["clean"] = False
+            out["por_leg"]["problems"] = problems
+    return out
+
+
 def cartography_verdict(run: dict, baseline: dict) -> dict:
     """``--cartography``: the search-cartography section
     (docs/telemetry.md).
@@ -202,7 +256,7 @@ def main(argv=None, fleet=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
-    stages = cartography = False
+    stages = cartography = independence = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -217,6 +271,8 @@ def main(argv=None, fleet=None) -> int:
             stages = True
         elif a == "--cartography":
             cartography = True
+        elif a == "--independence":
+            independence = True
         else:
             pos.append(a)
     if pos:
@@ -242,6 +298,12 @@ def main(argv=None, fleet=None) -> int:
     if sanitize and (verdict["fresh"] or allow_stale):
         verdict["sanitizer"] = sanitizer_verdict(fleet=fleet)
         verdict["ok"] = verdict["ok"] and verdict["sanitizer"]["clean"]
+    # same staleness economics as --sanitize: only fresh runs (or explicit
+    # stale comparisons) pay the fleet import+trace, and stale/pre-POR
+    # baselines never trip the gate
+    if independence and (verdict["fresh"] or allow_stale):
+        verdict["independence"] = independence_verdict(run, fleet=fleet)
+        verdict["ok"] = verdict["ok"] and verdict["independence"]["clean"]
     if stages:
         verdict["stages"] = stage_verdict(run, baseline)
         # only a FRESH run is required to carry attribution — a stored/
@@ -273,6 +335,13 @@ def main(argv=None, fleet=None) -> int:
             "regress: the example fleet FAILS the soundness sanitizer "
             "(JX2xx; see stdout JSON) — throughput from kernels with "
             "out-of-range indexing is not a valid measurement\n"
+        )
+        return 1
+    if "independence" in verdict and not verdict["independence"]["clean"]:
+        sys.stderr.write(
+            "regress: the static-independence gate FAILED (JX3xx fleet "
+            "matrix or the POR leg; see stdout JSON) — a reduction whose "
+            "matrix is malformed or whose counts drift is not sound\n"
         )
         return 1
     if (

@@ -25,6 +25,13 @@ and the Explorer's ``/.status``.  Rule catalogue: ``docs/analysis.md``.
 """
 
 from .audit import audit_model, config_signature
+from .footprint import extract_footprints
+from .independence import (
+    IndependenceReport,
+    PorPlan,
+    por_plan,
+    run_independence,
+)
 from .report import AuditError, AuditFinding, AuditReport, Severity
 from .sanitizer import (
     CheckedExecutionError,
@@ -38,10 +45,15 @@ __all__ = [
     "AuditFinding",
     "AuditReport",
     "CheckedExecutionError",
+    "IndependenceReport",
+    "PorPlan",
     "Severity",
     "audit_model",
     "checkify_kernels",
     "config_signature",
+    "extract_footprints",
     "localize_checked_failure",
+    "por_plan",
+    "run_independence",
     "run_sanitizer",
 ]

@@ -334,6 +334,14 @@ def audit_model(
         # the spawn preflight exists to abort on, and the interval walk is
         # a same-order cost as the structural audit's trace.
         run_sanitizer(twin, report, model=model, batch=batch)
+        # The static independence analysis (JX3xx, analysis/independence.py)
+        # is deliberately NOT part of the audit tiers: its footprint
+        # extraction re-traces every kernel, and the audit runs on every
+        # spawn and across whole test suites.  It has its own surfaces —
+        # the `independence` CLI verb + fleet gate, regress.py
+        # --independence, and the engines' lazy por() resolution (cached
+        # per twin) — and `independence.fold_into_report` exists for
+        # callers that want the findings merged into an AuditReport.
         _check_config_drift(
             model, twin, report, deep and not fresh_twin, sig=sig
         )

@@ -41,6 +41,8 @@ class CheckerBuilder:
         self.prewarm_mode: Optional[bool] = None
         self.prededup_mode: Optional[bool] = None
         self.compile_cache_dir: Optional[str] = None
+        # partial-order reduction (docs/analysis.md); None = env default
+        self.por_mode: Optional[bool] = None
 
     # -- configuration -------------------------------------------------------
 
@@ -213,6 +215,32 @@ class CheckerBuilder:
         ``STATERIGHT_TPU_COMPILE_CACHE=DIR``.  Per-rung hits are recorded
         in the flight recorder's ``compile`` events (``cache_hit``)."""
         self.compile_cache_dir = str(path)
+        return self
+
+    def por(self, enabled: bool = True) -> "CheckerBuilder":
+        """Partial-order reduction on the device engines
+        (``docs/analysis.md`` "State-space reduction"): the static
+        independence analysis (``analysis/independence.py``) derives a
+        per-model action×action conflict matrix from jaxpr footprints at
+        BitPacker-field granularity; the engines then mask each state's
+        enabled-action set down to a minimal conflict-closed **ample
+        subset** (a stubborn-set closure computed on device), with a
+        conservative cycle proviso — a state whose ample successors are
+        all duplicates is fully expanded, as is the first batch after
+        every growth/resume boundary.
+
+        Soundness contract (pinned by tests): property verdicts are
+        IDENTICAL to full expansion.  The analysis enforces this by
+        falling back to full expansion whenever reduction could be
+        unsound — ``eventually``/liveness properties, property-footprint
+        conflicts (an ample set may not contain a property-visible
+        action), undecidable footprints (conservatively dependent), or a
+        boundary-filtered twin.  With the flag OFF (the default) the step
+        jaxpr is bit-identical to a pre-POR engine (the
+        telemetry/checked/prededup discipline); env override
+        ``STATERIGHT_TPU_POR=1``.  Composes with ``symmetry()`` and
+        ``prededup()``."""
+        self.por_mode = bool(enabled)
         return self
 
     def checked(self, enabled: bool = True) -> "CheckerBuilder":

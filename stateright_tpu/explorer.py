@@ -151,6 +151,19 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
             sanitizer["checked_run"] = bool(
                 getattr(checker, "_checked", False)
             )
+    # partial-order reduction (docs/analysis.md): whether por() is active
+    # on this run, the fallback reason when not, and the live
+    # reduced-vs-full tallies; None when never requested
+    por = None
+    por_fn = getattr(checker, "por_status", None)
+    if por_fn is not None:
+        por = por_fn()
+    # independence summary, when a pass was folded into the model's
+    # report (independence.fold_into_report) — the audit tiers do not run
+    # it (it re-traces every kernel; see analysis/audit.py)
+    independence = None
+    if audit is not None:
+        independence = (audit.metrics or {}).get("independence")
     return {
         "done": checker.is_done(),
         "model": type(model).__name__,
@@ -160,6 +173,8 @@ def _status_view(model, checker, snapshot: _Snapshot) -> dict:
         "recent_path": snapshot.recent_path,
         "audit": audit.to_json() if audit is not None else None,
         "sanitizer": sanitizer,
+        "por": por,
+        "independence": independence,
         "table": table,
     }
 

@@ -41,6 +41,7 @@ def run_cli(
     profile: Optional[Callable[[list], None]] = None,
     sanitize: Optional[Callable[[list], None]] = None,
     report: Optional[Callable[[list], None]] = None,
+    independence: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -68,12 +69,15 @@ def run_cli(
         sanitize(rest)
     elif cmd == "report" and report is not None:
         report(rest)
+    elif cmd == "independence" and independence is not None:
+        independence(rest)
     else:
         print("USAGE:")
         print(usage)
         if check_tpu is not None:
             print("  device verbs also take --checked, --prewarm, "
-                  "--prededup, --compile-cache=DIR (docs/perf.md) and "
+                  "--prededup, --por, --compile-cache=DIR "
+                  "(docs/perf.md, docs/analysis.md) and "
                   "--watch (live status line, docs/telemetry.md)")
         if audit is not None:
             print("  <example> audit    # static preflight audit "
@@ -81,6 +85,9 @@ def run_cli(
         if sanitize is not None:
             print("  <example> sanitize # interval/bounds soundness "
                   "sanitizer (docs/analysis.md JX2xx)")
+        if independence is not None:
+            print("  <example> independence # static independence / "
+                  "conflict-matrix analysis (docs/analysis.md JX3xx)")
         if profile is not None:
             print("  <example> profile [--out=F] [--chrome=F] [ARGS]  "
                   "# telemetry run (docs/telemetry.md)")
@@ -107,13 +114,16 @@ def pop_perf(rest: list) -> tuple:
     :func:`apply_perf`.  Env knobs (``STATERIGHT_TPU_PREWARM`` etc.) still
     work without the flags — these exist so one-off CLI runs can A/B."""
     rest = list(rest)
-    cfg = {"prewarm": False, "prededup": False, "compile_cache": None}
+    cfg = {"prewarm": False, "prededup": False, "compile_cache": None,
+           "por": False}
     kept = []
     for a in rest:
         if a == "--prewarm":
             cfg["prewarm"] = True
         elif a == "--prededup":
             cfg["prededup"] = True
+        elif a == "--por":
+            cfg["por"] = True
         elif a.startswith("--compile-cache="):
             cfg["compile_cache"] = a[len("--compile-cache="):]
         else:
@@ -127,6 +137,8 @@ def apply_perf(builder, cfg: dict):
         builder = builder.prewarm()
     if cfg.get("prededup"):
         builder = builder.prededup()
+    if cfg.get("por"):
+        builder = builder.por()
     if cfg.get("compile_cache"):
         builder = builder.compile_cache(cfg["compile_cache"])
     return builder
@@ -354,6 +366,111 @@ def fleet_sanitize(names: Optional[list] = None, stream=None) -> int:
     return 0 if ok else 1
 
 
+# -- independence verb -------------------------------------------------------
+
+
+def independence_and_report(
+    models: Iterable[tuple], stream=None
+) -> tuple:
+    """Static independence / conflict-matrix view over ``(label, model)``
+    pairs (``analysis/independence.py``; docs/analysis.md JX3xx): one
+    summary line + the JX3xx findings each.  Returns ``(ok, rule_ids)``:
+    ``ok`` iff every twin-bearing model yields a WELL-FORMED conflict
+    matrix (square, symmetric, dependent diagonal) and no error-severity
+    JX3xx finding fires anywhere — the CI fleet gate's contract."""
+    import numpy as _np
+
+    from ..analysis import Severity, run_independence
+    from ..parallel.tensor_model import twin_or_none
+
+    stream = stream or sys.stdout
+    ok, bad_rules = True, set()
+    for label, model in models:
+        twin = twin_or_none(model)
+        print(f"--- {label}", file=stream)
+        if twin is None:
+            print(
+                "independence: no device twin for this configuration "
+                "(host checkers unaffected)",
+                file=stream,
+            )
+            continue
+        rep = run_independence(twin, list(model.properties()))
+        s = rep.summary()
+        c = _np.asarray(rep.conflict)
+        well_formed = (
+            c.ndim == 2
+            and c.shape == (rep.n_actions, rep.n_actions)
+            and bool(_np.array_equal(c, c.T))
+            and bool(c.diagonal().all())
+        )
+        print(
+            f"independence: {s['actions']} action(s), "
+            f"{s['independent_pairs']} independent pair(s), "
+            f"{s['visible_actions']} visible, "
+            f"{s['undecided_actions']} undecided; "
+            f"decomposed={s['decomposed']}; rules fired: "
+            f"{', '.join(s['rules']) or 'none'}",
+            file=stream,
+        )
+        if not well_formed:
+            ok = False
+            print("  MALFORMED conflict matrix", file=stream)
+        for f in rep.findings:
+            print("  " + f.format(), file=stream)
+            if f.severity == Severity.ERROR:
+                ok = False
+                bad_rules.add(f.rule_id)
+    return ok, tuple(sorted(bad_rules))
+
+
+def make_independence_cmd(
+    factory: Callable[[list], Iterable[tuple]]
+) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as an
+    ``independence`` CLI verb that exits 1 on error findings or a
+    malformed matrix."""
+
+    def _independence(rest: list) -> None:
+        ok, rules = independence_and_report(factory(rest))
+        if not ok:
+            print(f"independence: FAILED ({', '.join(rules) or 'matrix'})")
+            raise SystemExit(1)
+
+    return _independence
+
+
+def fleet_independence(names: Optional[list] = None, stream=None) -> int:
+    """Run the independence analysis over the whole example fleet (or
+    just ``names``); 0 iff every bundled example produces a well-formed
+    conflict matrix and no ERROR-level JX3xx finding.  Same coverage
+    contract as ``fleet_audit``/``fleet_sanitize``: a module without
+    ``_audit_models`` fails the gate."""
+    import importlib
+
+    from . import __all__ as all_names
+
+    stream = stream or sys.stdout
+    ok, bad = True, set()
+    for name in names or list(all_names):
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+        factory = getattr(mod, "_audit_models", None)
+        if factory is None:
+            print(
+                f"--- {name}: FAILED — no _audit_models hook (add one so "
+                "the fleet gate covers this example)",
+                file=stream,
+            )
+            ok = False
+            continue
+        mok, rules = independence_and_report(factory([]), stream=stream)
+        ok = ok and mok
+        bad.update(rules)
+    verdict = "CLEAN" if ok else f"FAILED ({', '.join(sorted(bad)) or 'matrix'})"
+    print(f"independence fleet: {verdict}", file=stream)
+    return 0 if ok else 1
+
+
 # -- profile verb ------------------------------------------------------------
 
 
@@ -576,6 +693,8 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_audit(argv[1:]))
     if argv and argv[0] == "sanitize":
         raise SystemExit(fleet_sanitize(argv[1:]))
+    if argv and argv[0] == "independence":
+        raise SystemExit(fleet_independence(argv[1:]))
     if argv and argv[0] == "profile":
         raise SystemExit(fleet_profile(argv[1:]))
     if argv and argv[0] == "report":
@@ -587,6 +706,9 @@ def main(argv: Optional[list] = None) -> None:
     print("  python -m stateright_tpu.models._cli sanitize [MODULE...]")
     print("    interval/bounds soundness sanitizer over the fleet "
           "(docs/analysis.md JX2xx); exit 1 on any error finding")
+    print("  python -m stateright_tpu.models._cli independence [MODULE...]")
+    print("    static independence / conflict-matrix analysis over the "
+          "fleet (docs/analysis.md JX3xx); exit 1 on any error finding")
     print("  python -m stateright_tpu.models._cli profile [MODULE] "
           "[--out=F] [--chrome=F] [ARGS...]")
     print("    telemetry-instrumented run; flight-recorder JSONL export "

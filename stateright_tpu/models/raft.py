@@ -40,6 +40,7 @@ from ._cli import (
     make_audit_cmd,
     make_profile_cmd,
     make_report_cmd,
+    make_independence_cmd,
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
@@ -329,6 +330,7 @@ def main(argv=None) -> None:
         spawn=spawn_cmd,
         audit=make_audit_cmd(_audit_models),
         sanitize=make_sanitize_cmd(_audit_models),
+        independence=make_independence_cmd(_audit_models),
         profile=make_profile_cmd(_audit_models),
         report=make_report_cmd(_audit_models),
         argv=argv,

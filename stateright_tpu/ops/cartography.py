@@ -174,6 +174,7 @@ def snapshot(
     unique: int,
     shard_load=None,
     route_matrix=None,
+    por=None,
 ) -> dict:
     """Assemble the host-facing cartography block (JSON-safe) from raw
     counter arrays.  ``states``/``unique`` are the engine's cumulative
@@ -205,4 +206,9 @@ def snapshot(
             [int(v) for v in row] for row in mat.reshape(mat.shape[-2], -1)
         ] if mat.ndim >= 2 else [[int(v) for v in mat.reshape(-1)]]
         out["routed_candidates"] = int(mat.sum())
+    if por is not None:
+        # partial-order reduction: the reduced-vs-full split (ops/por.py)
+        # — rows expanded with a reduced ample set, proviso-forced full
+        # re-expansions, and candidates never generated at all
+        out["por"] = {k: int(v) for k, v in dict(por).items()}
     return out

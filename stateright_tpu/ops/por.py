@@ -1,0 +1,161 @@
+"""Device-side ample-set selection for partial-order reduction.
+
+Given the compile-time :class:`~stateright_tpu.analysis.independence.PorPlan`
+(conflict matrix ``D``, per-action visibility, and the guard-conjunct
+enabler tensor ``EN``), :func:`ample_mask` computes a per-state **stubborn
+set** closure entirely on device and masks the enabled-action matrix down
+to its ample subset:
+
+ 1. per state, build the pull relation ``P``: an *enabled* action pulls
+    every action it conflicts with (``D`` row — the updates must commute
+    and neither may enable/disable the other); a *disabled* action pulls
+    the writers of its first FALSE guard conjunct (``EN`` — a necessary
+    enabling set: the action cannot become enabled until one of them
+    fires).  Conjunct truth comes from the footprint pass's conjunct
+    kernel (``analysis/footprint.conjunct_eval_fn``), a few bit-ops XLA
+    dead-code-eliminates out of the re-traced step kernel;
+ 2. close ``P`` transitively by boolean matrix squaring (``log2(A)``
+    batched matmuls — MXU-shaped work);
+ 3. every enabled seed yields a candidate ample set ``T(seed) ∩ enabled``;
+    pick the smallest candidate containing no property-VISIBLE action
+    (the C2 invisibility condition); no valid candidate, or nothing
+    smaller than the enabled set, means full expansion for that state.
+
+The cycle proviso (fully expand a state whose ample successors are all
+duplicates) lives in the engines — it needs the insert's novelty verdict;
+:func:`candidate_novelty` converts the insert's compacted ``sel``/``n_new``
+into the per-candidate novelty mask the proviso consumes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def plan_constants(plan):
+    """The plan's device constants: ``(D, EN, visible, leaf_idx)`` with
+    ``EN`` padded to at least one conjunct slot."""
+    d = np.asarray(plan.conflict, bool)
+    a = d.shape[0]
+    en = plan.enablers
+    if en is None:
+        en = np.ones((a, 1, a), bool)
+    return d, np.asarray(en, bool), np.asarray(plan.visible, bool), (
+        list(plan.leaf_idx) if plan.leaf_idx is not None else [None] * a
+    )
+
+
+def conjunct_truth(enabled, rows, plan, kernel):
+    """``bool[B, A, K]`` conjunct-truth tensor, or None when the conjunct
+    kernel is unavailable / its retrace drifted from the plan (the caller
+    must then use the union-of-all-enablers pull for disabled actions —
+    pairing a single whole-guard truth with a multi-conjunct enabler
+    tensor would pull only conjunct 0's writers, which is NOT a
+    necessary enabling set).
+
+    Per action: kernel leaves where the action has an extracted and-tree,
+    the enabled bit itself for the whole-guard fallback, True padding
+    past an action's conjunct count (padded slots pair with all-False
+    enabler rows and are never selected)."""
+    import jax.numpy as jnp
+
+    _, en, _, leaf_idx = plan_constants(plan)
+    a, k = en.shape[0], en.shape[1]
+    leaves = kernel(rows) if kernel is not None else None  # [B, L] | None
+    if leaves is None and any(idx is not None for idx in leaf_idx):
+        return None  # drift: truths for multi-conjunct actions unknown
+    ones = jnp.ones_like(enabled[:, 0])
+    cols = []
+    for i in range(a):
+        idx = leaf_idx[i] if leaves is not None else None
+        col = (
+            [leaves[:, j] for j in idx]
+            if idx is not None else [enabled[:, i]]
+        )
+        col = col + [ones] * (k - len(col))
+        cols.append(jnp.stack(col[:k], axis=-1))
+    return jnp.stack(cols, axis=1)  # [B, A, K]
+
+
+def ample_mask(enabled, rows, plan, kernel):
+    """Ample subset of ``enabled`` (``bool[B, A]``) under ``plan``.
+
+    Full expansion falls out naturally wherever no valid reduction
+    exists: every seed's closure visible/covering, or the smallest
+    candidate no smaller than the enabled set.
+    """
+    import jax.numpy as jnp
+
+    d_np, en_np, vis_np, _ = plan_constants(plan)
+    a = d_np.shape[0]
+    d = jnp.asarray(d_np)
+    en = jnp.asarray(en_np)
+    vis = jnp.asarray(vis_np)
+
+    ct = conjunct_truth(enabled, rows, plan, kernel)  # [B, A, K] | None
+    if ct is None:
+        # conjunct truths unavailable (kernel drift): a disabled action
+        # pulls the UNION of every conjunct's writers — a sound
+        # necessary-enabling superset, just less precise
+        pull_dis = jnp.broadcast_to(
+            jnp.any(en, axis=1)[None],
+            (enabled.shape[0], a, a),
+        )
+    else:
+        # first-false one-hot per action (all-true rows select nothing;
+        # the disabled fallback below unions every conjunct's enablers)
+        prev_true = jnp.cumprod(ct.astype(jnp.int32), axis=-1)
+        prev_true = jnp.concatenate(
+            [jnp.ones_like(prev_true[..., :1]), prev_true[..., :-1]],
+            axis=-1,
+        )
+        first_false = (~ct) & (prev_true > 0)  # [B, A, K]
+        pull_dis = jnp.einsum(
+            "bak,akj->baj",
+            first_false.astype(jnp.int32), en.astype(jnp.int32),
+        ) > 0
+        no_false = ~jnp.any(~ct, axis=-1)
+        pull_dis = jnp.where(
+            no_false[:, :, None], jnp.any(en, axis=1)[None], pull_dis
+        )
+    pull = jnp.where(enabled[:, :, None], d[None], pull_dis)  # [B, A, A]
+
+    reach = pull | jnp.eye(a, dtype=bool)[None]
+    for _ in range(max(int(a).bit_length(), 1)):
+        reach = reach | (
+            jnp.einsum(
+                "bik,bkj->bij",
+                reach.astype(jnp.int32), reach.astype(jnp.int32),
+            ) > 0
+        )
+
+    cand = reach & enabled[:, None, :]  # [B, seed, A]
+    size = jnp.sum(cand, axis=-1)
+    has_visible = jnp.any(cand & vis[None, None, :], axis=-1)
+    n_enabled = jnp.sum(enabled, axis=-1)
+    big = jnp.int32(a + 1)
+    score = jnp.where(
+        enabled & ~has_visible, size.astype(jnp.int32), big
+    )
+    best = jnp.argmin(score, axis=-1)
+    best_score = jnp.min(score, axis=-1)
+    amp = jnp.take_along_axis(cand, best[:, None, None], axis=1)[:, 0]
+    full = (best_score >= big) | (
+        best_score >= n_enabled.astype(jnp.int32)
+    )
+    return jnp.where(full[:, None], enabled, amp)
+
+
+def candidate_novelty(m: int, sel, n_new):
+    """Per-candidate novelty mask (``bool[m]``) from ``bucket_insert``'s
+    compacted ``sel``/``n_new``: True exactly on the candidate lanes the
+    insert claimed fresh table slots for.  Additive scatter on purpose:
+    ``sel`` entries past ``n_new`` are ARBITRARY in-range indices that
+    may collide with novel ones, and a ``set`` of their False would
+    clobber a True nondeterministically — adding 0 cannot."""
+    import jax.numpy as jnp
+
+    fresh = (jnp.arange(sel.shape[0], dtype=jnp.int32) < n_new).astype(
+        jnp.int32
+    )
+    return jnp.zeros((m,), jnp.int32).at[sel].add(fresh, mode="drop") > 0

@@ -95,6 +95,7 @@ class WavefrontChecker(Checker):
         # them is future work); the persistent compile cache is a global
         # JAX setting enabled here once a dir is configured.
         from .prewarm import (
+            ENV_POR,
             ENV_PREDEDUP,
             ENV_PREWARM,
             enable_persistent_compile_cache,
@@ -104,6 +105,41 @@ class WavefrontChecker(Checker):
         self._prededup = resolve_flag(
             getattr(options, "prededup_mode", None), ENV_PREDEDUP
         )
+        # partial-order reduction (analysis/independence.py): resolve the
+        # compile-time plan here — an unusable plan (liveness properties,
+        # no independent pair, undecidable footprints) falls back to full
+        # expansion and the engines never pay the ample-selection ops
+        self._por_plan = None
+        self._por_fallback = None
+        self._live_por = None
+        self._por = resolve_flag(
+            getattr(options, "por_mode", None), ENV_POR
+        )
+        if self._por:
+            from ..analysis.independence import por_plan
+
+            plan = por_plan(tensor, list(self.model.properties()))
+            if plan.usable:
+                self._por_plan = plan
+            else:
+                self._por = False
+                self._por_fallback = plan.fallback_reason
+                # once per model, like the preflight audit's warning
+                # print — repeated spawns (parity tests, bench loops)
+                # must not spam stderr
+                if not getattr(self.model, "_por_warn_printed", False):
+                    try:
+                        object.__setattr__(
+                            self.model, "_por_warn_printed", True
+                        )
+                    except Exception:  # noqa: BLE001 - __slots__ models
+                        pass
+                    print(
+                        "stateright-tpu: por(): falling back to full "
+                        f"expansion — {plan.fallback_reason} "
+                        "(docs/analysis.md)",
+                        file=sys.stderr,
+                    )
         self._prewarm = resolve_flag(
             getattr(options, "prewarm_mode", None), ENV_PREWARM
         )
@@ -365,6 +401,37 @@ class WavefrontChecker(Checker):
         return self
 
     # _maybe_write_report: inherited from Checker (checker/base.py)
+
+    def por_status(self) -> Optional[dict]:
+        """Partial-order-reduction status of this run, or None when
+        ``por()`` was never requested: whether reduction is active, the
+        fallback reason when not, and the live reduced-vs-full tallies
+        (rows expanded with a reduced ample set, proviso-forced full
+        expansions, candidates never generated)."""
+        requested = self._por or self._por_fallback is not None
+        if not requested:
+            return None
+        out = {
+            "enabled": bool(self._por),
+            "fallback": self._por_fallback,
+        }
+        stats = None
+        if self._results and "por" in self._results:
+            stats = self._results["por"]
+        elif self._live_por is not None:
+            stats = self._live_por
+        if stats is not None:
+            out.update(stats)
+        return out
+
+    def _por_stats_dict(self, arr) -> dict:
+        """The packed por-stats triple as the JSON-facing dict."""
+        arr = np.asarray(arr).astype(np.int64).reshape(-1)
+        return {
+            "rows_reduced": int(arr[0]),
+            "rows_full_proviso": int(arr[1]),
+            "candidates_masked": int(arr[2]),
+        }
 
     def cartography(self) -> Optional[dict]:
         """Latest search-cartography snapshot (``ops/cartography.py``), or

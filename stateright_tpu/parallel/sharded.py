@@ -104,6 +104,7 @@ def _build_sharded_run(
     cand_local: Optional[int] = None,
     prededup: bool = False,
     cartography: bool = False,
+    por=None,
 ):
     """Build the jitted whole-run shard_map for fixed per-device capacities.
 
@@ -119,6 +120,19 @@ def _build_sharded_run(
     generated on different devices still meet (and dedup) at the owner.
     Counts/traces are bit-identical either way (same contract as the
     single-device engine; pinned by tests).
+
+    ``por`` is the resolved partial-order-reduction plan (None = off):
+    each wavefront masks the enabled-action matrix down to per-state
+    ample subsets (``ops/por.ample_mask``) before routing; the insert's
+    per-candidate novelty verdict travels BACK through a reverse
+    all-to-all so each source row learns whether any of its ample
+    successors was fresh, and rows whose ample successors were all
+    duplicates re-expand their remaining actions through a second
+    route+insert in the same step (the conservative cycle proviso).  The
+    whole two-phase step stays atomic under the rollback.  A replicated
+    ``boost`` scalar forces one fully expanded wavefront after every
+    growth/resume boundary.  Off means the program is bit-identical to a
+    pre-POR build (the ``prededup``/``cartography`` contract).
 
     ``cartography`` appends the search counters (``ops/cartography.py``)
     to the carry: the replicated depth/action/property tallies the
@@ -148,6 +162,12 @@ def _build_sharded_run(
     m_cand = fcap_local * arity
     if cand_local is not None:
         cand_local = min(cand_local, ndev * bucket_cap)
+
+    if por is not None:
+        from ..analysis.footprint import conjunct_eval_fn
+        from ..ops.por import ample_mask
+
+        conjunct_kernel = conjunct_eval_fn(tensor)
 
     def owner_of(fps):
         return ((fps >> jnp.uint64(32)) % jnp.uint64(ndev)).astype(jnp.int32)
@@ -239,12 +259,17 @@ def _build_sharded_run(
         recv_par = a2a(send_par).reshape(ndev * bucket_cap)
         recv_ebt = a2a(send_ebt).reshape(ndev * bucket_cap)
         overflow = jax.lax.pmax(overflow, AXIS)
-        return recv_fp, recv_rows, recv_par, recv_ebt, overflow
+        # routing aux (order/destination/rank/validity): lets the POR path
+        # route the owner-side novelty verdict back to the source lanes;
+        # plain python refs, zero extra ops for non-POR builds
+        return recv_fp, recv_rows, recv_par, recv_ebt, overflow, (
+            order, d_idx, r_idx, ok
+        )
 
     # -- owner-side dedup + insert + compaction ------------------------------
 
     def insert_and_compact(tfp, tpl, cand_rows, cand_fp, cand_par,
-                           cand_ebits, compact=None):
+                           cand_ebits, compact=None, want_novel=False):
         """Dedup candidates, claim table slots (bucketized one-shot insert —
         same visited-set as the single-device engine, ``ops/buckets.py``;
         the round-1 probe-loop insert cost a full-size scatter per
@@ -258,6 +283,13 @@ def _build_sharded_run(
             window=min(m, max(64, fcap_local)), generation_order=sym,
             compact=compact,
         )
+        novel = None
+        if want_novel:
+            # per-received-candidate novelty, BEFORE the frontier trim —
+            # the POR proviso routes this back to the source device
+            from ..ops.por import candidate_novelty
+
+            novel = candidate_novelty(m, sel, n_new)
         sel_w = sel.shape[0]
         take = min(sel_w, fcap_local)
         sel = sel[:take]  # original indices, novel-compacted
@@ -269,7 +301,7 @@ def _build_sharded_run(
             nrows = jnp.concatenate([nrows, jnp.zeros((pad, width), jnp.uint64)])
             nfps = jnp.concatenate([nfps, jnp.full((pad,), EMPTY, jnp.uint64)])
             nebt = jnp.concatenate([nebt, jnp.zeros((pad,), jnp.uint32)])
-        return tfp, tpl, nrows, nfps, nebt, n_new, toverflow, coverflow
+        return tfp, tpl, nrows, nfps, nebt, n_new, toverflow, coverflow, novel
 
     # -- the per-device program ----------------------------------------------
 
@@ -287,7 +319,7 @@ def _build_sharded_run(
         cand_fp = jnp.where(mine, ifp, EMPTY)
         cand_par = jnp.zeros((n_init,), jnp.uint64)  # 0 = init state
         cand_ebt = jnp.full((n_init,), init_ebits, jnp.uint32)
-        tfp, tpl, rows0, fps0, ebt0, n_new, toverflow, _ = (
+        tfp, tpl, rows0, fps0, ebt0, n_new, toverflow, _, _ = (
             insert_and_compact(tfp, tpl, irows, cand_fp, cand_par, cand_ebt)
         )
         unique = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
@@ -305,6 +337,10 @@ def _build_sharded_run(
                  jnp.int64(n_init),  # state_count counts all inits
                  jnp.zeros((max(n_props, 1),), jnp.uint64),
                  jnp.int32(0), status)
+        if por is not None:
+            # replicated boost scalar + reduced-vs-full tallies; the init
+            # wavefront is not a growth/resume boundary (boost=0)
+            carry = carry + (jnp.int32(0), jnp.zeros((3,), jnp.int64))
         if cartography:
             carry = carry + cart_init(unique, n_new)
         return carry + (keep_going(carry).astype(jnp.int32),)
@@ -328,7 +364,11 @@ def _build_sharded_run(
         def expand(carry):
             (tfp, tpl, rows, fps, ebits, unique, scount, disc, depth,
              status) = carry[:10]
-            cart = carry[10:]
+            if por is not None:
+                boost, pstats = carry[10], carry[11]
+                cart = carry[12:]
+            else:
+                cart = carry[10:]
             live = fps != EMPTY
             masks = tensor.property_masks(rows)  # [F, P] bool
             ebits, disc = eval_props(masks, fps, live, ebits, disc)
@@ -342,14 +382,27 @@ def _build_sharded_run(
                 # host-checker parity: boundary filter before counting
                 valid = valid & boundary_fn(succ)
             valid = valid & elive[:, None]
-            scount = scount + jax.lax.psum(jnp.sum(valid, dtype=jnp.int64), AXIS)
             terminal = elive & ~jnp.any(valid, axis=-1)
             disc = flush_terminal(terminal, fps, ebits, disc)
 
             # symmetry: route + dedup on the canonical class key while the
             # frontier carries original rows (see wavefront.py step)
             krows = tensor.representative_rows(succ) if sym else succ
-            cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m_cand)
+            if por is not None:
+                # ample-set selection before routing: masked candidates
+                # pay neither ICI transfer nor owner-side insert width
+                amp = ample_mask(valid, rows, por, conjunct_kernel)
+                amp = jnp.where(boost > 0, valid, amp)
+                v1 = amp
+                all_fp = jnp.where(valid, row_hash(krows), EMPTY)
+                cand_fp = jnp.where(v1, all_fp, EMPTY).reshape(m_cand)
+            else:
+                # the pre-POR expression verbatim: off-path program must
+                # stay bit-identical (see wavefront.py)
+                v1 = valid
+                cand_fp = jnp.where(
+                    valid, row_hash(krows), EMPTY
+                ).reshape(m_cand)
             if prededup:
                 # intra-window pre-dedup before routing: duplicate lanes
                 # drop out of the all-to-all AND the owner-side insert
@@ -358,16 +411,81 @@ def _build_sharded_run(
             cand_par = jnp.broadcast_to(fps[:, None], (fcap_local, arity)).reshape(-1)
             cand_ebt = jnp.broadcast_to(ebits[:, None], (fcap_local, arity)).reshape(-1)
 
-            rfp, rrows, rpar, rebt, boverflow = route(
+            rfp, rrows, rpar, rebt, boverflow, aux = route(
                 cand_fp, cand_rows, cand_par, cand_ebt
             )
-            tfp, tpl, nrows, nfps, nebt, n_new, toverflow, coverflow = (
+            tfp, tpl, nrows, nfps, nebt, n_new, toverflow, coverflow, novel_recv = (
                 insert_and_compact(tfp, tpl, rrows, rfp, rpar, rebt,
-                                   compact=cand_local)
+                                   compact=cand_local,
+                                   want_novel=por is not None)
             )
+            if por is not None:
+                # cycle proviso, cross-device: the owner-side novelty
+                # verdict travels back through the REVERSE all-to-all
+                # (the collective is an involution on the [D, C] layout),
+                # then unsorts through the routing aux to the original
+                # candidate lanes — each source row learns whether any of
+                # its ample successors claimed a fresh slot
+                order, d_idx, r_idx, ok = aux
+                novel_send = jax.lax.all_to_all(
+                    novel_recv.reshape(ndev, bucket_cap), AXIS, 0, 0,
+                    tiled=False,
+                )
+                ns = novel_send[
+                    jnp.clip(d_idx, 0, ndev - 1), r_idx
+                ] & ok
+                novel = (cand_fp != cand_fp).at[order].set(ns)
+                fresh_row = jnp.any(
+                    novel.reshape(fcap_local, arity), axis=1
+                )
+                reduced_row = jnp.any(valid & ~amp, axis=1)
+                need_full = reduced_row & ~fresh_row
+                v2 = valid & ~amp & need_full[:, None]
+                cand_fp2 = jnp.where(v2, all_fp, EMPTY).reshape(m_cand)
+                if prededup:
+                    cand_fp2 = window_unique(cand_fp2)
+                rfp2, rrows2, rpar2, rebt2, bovf2, _ = route(
+                    cand_fp2, cand_rows, cand_par, cand_ebt
+                )
+                (tfp, tpl, nrows2, nfps2, nebt2, n_new2, tovf2, covf2,
+                 _) = insert_and_compact(
+                    tfp, tpl, rrows2, rfp2, rpar2, rebt2,
+                    compact=cand_local,
+                )
+                # merge the two compacted frontier segments: non-EMPTY
+                # first, stable (phase-1 novelty order preserved)
+                fps_all = jnp.concatenate([nfps, nfps2])
+                morder = jnp.argsort(fps_all == EMPTY, stable=True)
+                take = morder[:fcap_local]
+                nrows = jnp.concatenate([nrows, nrows2])[take]
+                nfps = fps_all[take]
+                nebt = jnp.concatenate([nebt, nebt2])[take]
+                foverflow = jax.lax.pmax(
+                    (n_new + n_new2) > fcap_local, AXIS
+                )
+                n_new = n_new + n_new2
+                toverflow = toverflow | tovf2
+                coverflow = coverflow | covf2
+                boverflow = boverflow | bovf2
+                gen_mask = v1 | v2
+            else:
+                gen_mask = valid
+                foverflow = jax.lax.pmax(n_new > fcap_local, AXIS)
+            gen = jnp.sum(gen_mask, dtype=jnp.int64)
+            scount = scount + jax.lax.psum(gen, AXIS)
+            if por is not None:
+                pstats = pstats + jnp.stack([
+                    jax.lax.psum(jnp.sum(
+                        reduced_row & ~need_full, dtype=jnp.int64
+                    ), AXIS),
+                    jax.lax.psum(jnp.sum(need_full, dtype=jnp.int64), AXIS),
+                    jax.lax.psum(
+                        jnp.sum(valid, dtype=jnp.int64) - gen, AXIS
+                    ),
+                ])
+                boost = jnp.int32(0)  # consumed; rollback re-arms on replay
             n_new_g = jax.lax.psum(n_new.astype(jnp.int64), AXIS)
             unique = unique + n_new_g
-            foverflow = jax.lax.pmax(n_new > fcap_local, AXIS)
             coverflow = jax.lax.pmax(coverflow, AXIS)
             # proactive growth at 25% GLOBAL load: past it the Poisson bucket
             # overflow tail stops being negligible (cf. wavefront.py).  The
@@ -414,26 +532,36 @@ def _build_sharded_run(
                     jnp.clip(depth, 0, DEPTH_BINS - 1)
                 ].add(n_new_g)
                 act_hist = act_hist + jax.lax.psum(
-                    action_hist_delta(valid), AXIS
+                    action_hist_delta(gen_mask), AXIS
                 )
                 d_evals, d_hits = prop_tally_delta(live, masks, n_props)
                 p_evals = p_evals + jax.lax.psum(d_evals, AXIS)
                 p_hits = p_hits + jax.lax.psum(d_hits, AXIS)
                 # shard extras stay device-local (varying): per-shard fresh
                 # inserts, and this shard's routed-candidate row (what it
-                # SENT per destination through the all-to-all)
+                # SENT per destination through the all-to-all — both POR
+                # phases' routed lanes count)
                 shard_load = shard_load + n_new.astype(jnp.int64)[None]
-                cvalid = cand_fp != EMPTY
-                owner = jnp.where(cvalid, owner_of(cand_fp), jnp.int32(ndev))
-                d_route = jnp.zeros((ndev,), jnp.int64).at[owner].add(
-                    jnp.where(cvalid, jnp.int64(1), jnp.int64(0)),
-                    mode="drop",
+                routed = [cand_fp] + (
+                    [cand_fp2] if por is not None else []
                 )
-                route_mat = route_mat + d_route[None, :]
+                for rf in routed:
+                    cvalid = rf != EMPTY
+                    owner = jnp.where(
+                        cvalid, owner_of(rf), jnp.int32(ndev)
+                    )
+                    d_route = jnp.zeros((ndev,), jnp.int64).at[owner].add(
+                        jnp.where(cvalid, jnp.int64(1), jnp.int64(0)),
+                        mode="drop",
+                    )
+                    route_mat = route_mat + d_route[None, :]
                 cart = (depth_hist, act_hist, p_evals, p_hits, shard_load,
                         route_mat)
-            return (tfp, tpl, nrows, nfps, nebt, unique, scount, disc,
-                    depth, status) + tuple(cart)
+            out = (tfp, tpl, nrows, nfps, nebt, unique, scount, disc,
+                   depth, status)
+            if por is not None:
+                out = out + (boost, pstats)
+            return out + tuple(cart)
 
         def body(carry):
             new = expand(carry)
@@ -473,6 +601,9 @@ def _build_sharded_run(
         return carry + (keep_going(carry).astype(jnp.int32),)
 
     in_specs = (P(AXIS),) * 5 + (P(),) * 5
+    if por is not None:
+        # replicated boost scalar + reduced-vs-full tallies
+        in_specs = in_specs + (P(), P())
     if cartography:
         # replicated depth/action/property tallies + sharded load/route
         in_specs = in_specs + (P(),) * 4 + (P(AXIS), P(AXIS))
@@ -602,6 +733,19 @@ class ShardedTpuChecker(WavefrontChecker):
         zeros.append(np.zeros((self.ndev, self.ndev), np.int64))
         return zeros
 
+    def _por_resume_host(self) -> list:
+        """POR carry-tail seed for a resumed/finished carry: boost=1 (a
+        resume IS a snapshot boundary — the proviso arms one fully
+        expanded wavefront) + the snapshot's cumulative tallies (zeros
+        for pre-POR snapshots)."""
+        if not self._por:
+            return []
+        snap = self._resume if self._resume is not None else {}
+        stats = np.asarray(
+            snap.get("por_stats", np.zeros((3,), np.int64)), np.int64
+        ).reshape(3)
+        return [np.int32(1), stats]
+
     def _cart_resume_host(self) -> list:
         """Cartography counter tail for a resumed carry: the snapshot's
         stored cumulative counters when present (``cart0``..``cart5``,
@@ -629,6 +773,7 @@ class ShardedTpuChecker(WavefrontChecker):
             prop_names=[pr.name for pr in self._props],
             states=states, unique=unique,
             shard_load=load, route_matrix=route,
+            por=self._live_por if self._por else None,
         )
         self._live_cart = snap
         if self.flight_recorder is not None:
@@ -701,11 +846,18 @@ class ShardedTpuChecker(WavefrontChecker):
             k: np.asarray(v)
             for k, v in zip(_SHARDED_SNAPSHOT_KEYS, carry)
         }
+        tail = list(carry[10:])
+        if self._por:
+            # the boost scalar is NOT persisted (resume always re-arms a
+            # fully expanded wavefront); the cumulative reduced-vs-full
+            # tallies are, like the cartography counters below
+            snap["por_stats"] = np.asarray(tail[1])
+            tail = tail[2:]
         # cartography counter tail (cumulative, in-carry on this engine):
         # persisted so a resumed run's histograms keep reconciling with
         # the cumulative totals (sum(depth_hist) == unique) instead of
         # restarting at zero against a non-zero ``unique``
-        for i, v in enumerate(carry[10:]):
+        for i, v in enumerate(tail):
             snap[f"cart{i}"] = np.asarray(v)
         snap["more"] = int(np.asarray(more))
         snap["ndev"] = self.ndev
@@ -914,15 +1066,19 @@ class ShardedTpuChecker(WavefrontChecker):
             else:
                 finished = carry0
 
-        # cartography tail: 4 replicated counter buffers + 2 shard-local
-        # ones ride the carry after the 10 base elements (ops/cartography.py)
-        ncarry = 10 + (6 if self._cartography else 0)
+        # carry tail: [por boost + tallies]? then the cartography tail
+        # (4 replicated counter buffers + 2 shard-local ones) after the
+        # 10 base elements (ops/por.py, ops/cartography.py)
+        por_n = 2 if self._por else 0
+        cart_lo = 10 + por_n
+        ncarry = cart_lo + (6 if self._cartography else 0)
         while True:  # one iteration per engine build (growth rebuilds)
             bucket_cap = max(64, (fcap * arity * bf) // self.ndev)
             cand_local = max(64, cf * fcap)
             sym = self._symmetry is not None
             key = (mesh_key, cap, fcap, bucket_cap, cand_local, self._target,
-                   sym, self._steps, self._prededup, self._cartography)
+                   sym, self._steps, self._prededup, self._cartography,
+                   self._por)
             fns = cache.get(key)
             if rec is not None and key != getattr(
                 self, "_last_engine_key", None
@@ -950,6 +1106,7 @@ class ShardedTpuChecker(WavefrontChecker):
                     self._target, sym=sym, steps=self._steps,
                     cand_local=cand_local, prededup=self._prededup,
                     cartography=self._cartography,
+                    por=self._por_plan if self._por else None,
                 )
                 cache[key] = fns
             init_fn, step_fn = fns
@@ -959,16 +1116,21 @@ class ShardedTpuChecker(WavefrontChecker):
             if finished is not None:
                 out = (
                     tuple(jnp.asarray(c) for c in finished)
+                    + tuple(jnp.asarray(z) for z in self._por_resume_host())
                     + tuple(jnp.asarray(z) for z in self._cart_resume_host())
                     + (jnp.int32(0),)
                 )
                 watch = None
             elif pending is not None:
-                if self._cartography and len(pending) == 10:
-                    # re-seed the counter tail from the snapshot's stored
-                    # cumulative counters (zeros only for pre-cartography
-                    # snapshots) so resumed histograms keep reconciling
-                    pending = list(pending) + self._cart_resume_host()
+                if len(pending) == 10:
+                    # re-seed the carry tail: the POR boost/tallies and the
+                    # snapshot's stored cumulative cartography counters
+                    # (zeros only for snapshots predating each feature)
+                    pending = (
+                        list(pending)
+                        + self._por_resume_host()
+                        + self._cart_resume_host()
+                    )
                 out = step_fn(*pending)
                 pending = None
             else:
@@ -980,11 +1142,17 @@ class ShardedTpuChecker(WavefrontChecker):
                 # device-resident between calls
                 carry = out[:ncarry]
                 pulls = [out[5], out[6], out[8], out[9], out[ncarry], out[7]]
+                if self._por:
+                    pulls.append(out[11])  # the reduced-vs-full tallies
                 if self._cartography:
-                    pulls.extend(out[10:ncarry])
+                    pulls.extend(out[cart_lo:ncarry])
                 got = jax.device_get(tuple(pulls))
                 unique, scount, depth, status, more, disc = got[:6]
-                cart_arrs = got[6:]
+                tail_arrs = got[6:]
+                if self._por:
+                    self._live_por = self._por_stats_dict(tail_arrs[0])
+                    tail_arrs = tail_arrs[1:]
+                cart_arrs = tail_arrs
                 if rec is not None and watch is not None:
                     # the device_get above blocked on the dispatched block:
                     # dispatch-to-materialize is the real device+compile wall
@@ -1105,6 +1273,15 @@ class ShardedTpuChecker(WavefrontChecker):
                     cap, fcap, bf, cf, pending = self._grow_carry_lockstep(
                         carry, cap, fcap, bf, cf, status
                     )
+                    if self._por:
+                        # growth is a boundary: arm one fully expanded
+                        # wavefront (replicated scalar, lockstep-safe)
+                        from jax.sharding import NamedSharding
+
+                        pending = list(pending)
+                        pending[10] = jax.device_put(
+                            jnp.int32(1), NamedSharding(self.mesh, P())
+                        )
                     self._stage("growth", time.monotonic() - t_grow)
                 continue
             break
@@ -1120,6 +1297,8 @@ class ShardedTpuChecker(WavefrontChecker):
             "table_fp": self._host_table(carry[0]),
             "table_parent": self._host_table(carry[1]),
         }
+        if self._por and self._live_por is not None:
+            self._results["por"] = dict(self._live_por)
         if self._cartography and getattr(self, "_live_cart", None):
             self._results["cartography"] = self._live_cart
             if rec is not None:

@@ -118,14 +118,18 @@ _ST_DISC = 6
 _STATS_CARRY_ORDER = (_HEAD, _TAIL, _UNIQUE, _SCOUNT, _MAXDEPTH, _STATUS)
 
 
-def _stats_np(carry, cart_start: Optional[int] = None) -> np.ndarray:
+def _stats_np(carry, cart_start: Optional[int] = None,
+              por_start: Optional[int] = None) -> np.ndarray:
     """Host-side equivalent of the jitted ``stats_of`` (same layout).
+    ``por_start`` appends the POR stats triple (carry[por_start + 1]);
     ``cart_start`` appends the cartography section: the queue-derived
     depth histogram first, then the counter buffers (carry tail from that
     index on), exactly as the device ``stats_of`` does."""
     vals = [np.asarray(carry[i]) for i in _STATS_CARRY_ORDER] + list(
         np.asarray(carry[_DISC])
     )
+    if por_start is not None:
+        vals.extend(np.asarray(carry[por_start + 1]).reshape(-1))
     if cart_start is not None:
         from ..ops.cartography import queue_depth_hist_np
 
@@ -143,7 +147,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
                   sym: bool = False, cand: Optional[int] = None,
                   checked: bool = False, prededup: bool = False,
-                  cartography: bool = False):
+                  cartography: bool = False, por=None):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -164,6 +168,20 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     filter keeps exactly the lane the insert's stable sort would keep);
     off by default, and off means zero extra ops in the step jaxpr.
 
+    ``por`` is the resolved partial-order-reduction plan
+    (``analysis/independence.PorPlan``, None = off): each batch masks its
+    enabled-action matrix down to a per-state ample subset
+    (``ops/por.ample_mask`` — the stubborn-set closure over the
+    compile-time conflict matrix) and inserts only the ample successors;
+    a second insert in the same step fully expands exactly the rows whose
+    ample successors were ALL duplicates (the conservative cycle
+    proviso), and a ``boost`` carry scalar forces one fully-expanded
+    batch after every growth/resume boundary.  Both inserts are atomic
+    together: any overflow rolls the table back to the pre-step buffers
+    so the replay after growth sees the same novelty verdicts.  Off means
+    zero extra ops in the step jaxpr (the telemetry/checked/prededup
+    contract, pinned by test).
+
     ``checked`` is the sanitizer's dynamic guard
     (``stateright_tpu/analysis/sanitizer.py``): the MODEL kernels
     (``property_masks`` + ``step_rows``) run under
@@ -180,7 +198,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     width, arity = tensor.width, tensor.max_actions
     m = batch * arity
     eff_cand = min(cand, m) if cand else m
-    qalloc = qcap + m
+    # POR's cycle proviso appends a SECOND novel window per step (at
+    # tail + n_new): over-allocate one more window so both appends stay
+    # in bounds without clamping — a clamped dynamic_update_slice would
+    # silently shift the write onto live queue rows
+    qalloc = qcap + (2 * m if por is not None else m)
     n_props = len(props)
     ev_idx = [
         i for i, p in enumerate(props) if p.expectation is Expectation.EVENTUALLY
@@ -203,13 +225,22 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # reconstructs the full message anyway
         checked_kernels = checkify_kernels(tensor)
 
+    # carry tail layout: [base 13] + [err]? + [por boost, por stats]? +
+    # [cartography buffers]?  (snapshots keep only the base; every tail
+    # element re-seeds at resume)
+    por_start = (_ERR + 1) if checked else _ERR
+    cart_start = por_start + (2 if por is not None else 0)
+    if por is not None:
+        from ..analysis.footprint import conjunct_eval_fn
+        from ..ops.por import ample_mask, candidate_novelty
+
+        conjunct_kernel = conjunct_eval_fn(tensor)
     # search-cartography counters (ops/cartography.py): carry tail AFTER
     # the checked error flag — action histogram + property tallies only;
     # the depth histogram is queue-derived at sync time (queue_depth_hist),
     # so the per-step cost stays at two small column-sums.  Off means zero
     # extra ops in the step jaxpr (same contract as
     # telemetry/checked/prededup, pinned by test)
-    cart_start = (_ERR + 1) if checked else _ERR
     if cartography:
         from ..ops.cartography import (
             action_hist_delta,
@@ -304,12 +335,29 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # hash — the host analogue is ``checker/dfs.py::_dedup_key``, and it
         # preserves the reference's pinned symmetry counts (2pc.rs:138).
         krows = tensor.representative_rows(succ) if sym else succ
-        cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m)
+        if por is not None:
+            # ample-set selection: expand only a minimal conflict-closed
+            # subset of each row's enabled actions; the boost scalar (set
+            # by the host at growth/resume boundaries) forces one fully
+            # expanded batch, and stays armed until a batch succeeds
+            boost = carry[por_start]
+            pstats = carry[por_start + 1]
+            amp = ample_mask(valid, rows, por, conjunct_kernel)
+            amp = jnp.where(boost > 0, valid, amp)
+            v1 = amp
+            all_fp = jnp.where(valid, row_hash(krows), EMPTY)
+            cand_fp = jnp.where(v1, all_fp, EMPTY).reshape(m)
+        else:
+            # exactly the pre-POR expression: the off-path jaxpr must stay
+            # bit-identical (a nested same-predicate select would add an
+            # eqn and silently break the cross-release compile cache)
+            v1 = valid
+            cand_fp = jnp.where(valid, row_hash(krows), EMPTY).reshape(m)
         if prededup:
             # intra-window pre-dedup (BLEST-style): duplicate lanes become
             # EMPTY so the compaction budget, membership gathers, and rank
             # pipeline run at the window's UNIQUE count.  scount deliberately
-            # still sums ``valid`` (generated states, duplicates included).
+            # still sums the generated states, duplicates included.
             cand_fp = window_unique(cand_fp)
         cand_rows = succ.reshape(m, width)
         cand_par = jnp.broadcast_to(fps[:, None], (batch, arity)).reshape(-1)
@@ -318,6 +366,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             depths[:, None] + jnp.uint32(1), (batch, arity)
         ).reshape(-1)
 
+        if por is not None:
+            tfp_pre, tpl_pre = tfp, tpl  # two-phase atomic rollback
         # window stays at ``batch`` (measured: one cand-wide loop iteration
         # is SLOWER than 2-3 batch-wide ones — wide iterations pay for dead
         # lanes; the compaction budget only bounds the pipeline width)
@@ -334,27 +384,84 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         qebits = jax.lax.dynamic_update_slice(qebits, cand_ebt[sel], (tail,))
         qdepth = jax.lax.dynamic_update_slice(qdepth, cand_dep[sel], (tail,))
 
-        # Any overflow means the insert wrote nothing (n_new == 0): leave
-        # the cursors and counters untouched so the batch replays after the
-        # host grows the table / candidate budget.  (The queue append above
-        # wrote garbage past ``tail``, which the replay overwrites.)
+        if por is not None:
+            # conservative cycle proviso: a reduced row whose ample
+            # successors were ALL duplicates is fully expanded — its
+            # remaining (non-ample) candidates go through a second insert
+            # in the same step, so no state can be starved around a cycle
+            novel = candidate_novelty(m, sel, n_new)
+            reduced_row = jnp.any(valid & ~amp, axis=1)
+            fresh_row = jnp.any(novel.reshape(batch, arity), axis=1)
+            need_full = reduced_row & ~fresh_row
+            v2 = valid & ~amp & need_full[:, None]
+            cand_fp2 = jnp.where(v2, all_fp, EMPTY).reshape(m)
+            if prededup:
+                cand_fp2 = window_unique(cand_fp2)
+            tail1 = tail + n_new
+            tfp, tpl, sel2, n_new2, tovf2, covf2 = bucket_insert(
+                tfp, tpl, cand_fp2, cand_par, window=batch,
+                use_pallas=pallas, generation_order=sym, compact=eff_cand,
+            )
+            qrows = jax.lax.dynamic_update_slice(
+                qrows, cand_rows[sel2], (tail1, jnp.int32(0))
+            )
+            qfp = jax.lax.dynamic_update_slice(qfp, cand_fp2[sel2], (tail1,))
+            qebits = jax.lax.dynamic_update_slice(
+                qebits, cand_ebt[sel2], (tail1,)
+            )
+            qdepth = jax.lax.dynamic_update_slice(
+                qdepth, cand_dep[sel2], (tail1,)
+            )
+            toverflow = toverflow | tovf2
+            coverflow = coverflow | covf2
+            n_new_all = n_new + n_new2
+        else:
+            n_new_all = n_new
+
+        # Any overflow means the batch wrote nothing durable: leave the
+        # cursors and counters untouched so the batch replays after the
+        # host grows the table / candidate budget.  (The queue appends
+        # above wrote garbage past ``tail``, which the replay overwrites;
+        # with POR's two inserts the table itself rolls back so the replay
+        # sees the same novelty verdicts.)
         overflow = toverflow | coverflow
+        if por is not None:
+            tfp = jnp.where(overflow, tfp_pre, tfp)
+            tpl = jnp.where(overflow, tpl_pre, tpl)
+            n_new_all = jnp.where(overflow, 0, n_new_all)
         head = jnp.where(overflow, head, head + jnp.minimum(n_avail, batch))
-        tail = tail + n_new
-        unique = unique + n_new.astype(jnp.int64)
-        scount = jnp.where(
-            overflow, scount, scount + jnp.sum(valid, dtype=jnp.int64)
-        )
+        tail = tail + n_new_all
+        unique = unique + n_new_all.astype(jnp.int64)
+        if por is not None:
+            gen_mask = v1 | v2
+            gen = jnp.sum(gen_mask, dtype=jnp.int64)
+        else:
+            gen_mask = valid
+            gen = jnp.sum(valid, dtype=jnp.int64)
+        scount = jnp.where(overflow, scount, scount + gen)
+        if por is not None:
+            zero64 = jnp.int64(0)
+            d_por = jnp.stack([
+                jnp.sum(reduced_row & ~need_full, dtype=jnp.int64),
+                jnp.sum(need_full, dtype=jnp.int64),
+                jnp.sum(valid, dtype=jnp.int64) - gen,
+            ])
+            pstats = pstats + jnp.where(overflow, zero64, d_por)
+            # a successful batch consumes the boundary boost; a replayed
+            # (overflowed) one keeps it armed
+            boost = jnp.where(overflow, boost, jnp.int32(0))
         if cartography:
             # same replay discipline as scount: an overflowed batch counts
             # nothing so the post-growth replay is the only count.  (The
             # depth histogram needs no guard at all: it is derived from the
             # queue at sync time, and an overflowed insert appended
-            # nothing.)
+            # nothing.)  Under POR the histogram counts what was actually
+            # GENERATED (ample + proviso re-expansions), which is what
+            # reconciles against scount.
             act_hist, p_evals, p_hits = cart
             zero = jnp.int64(0)
             act_hist = act_hist + jnp.where(
-                overflow, zero, action_hist_delta(valid)
+                overflow, zero, action_hist_delta(gen_mask)
             )
             d_evals, d_hits = prop_tally_delta(live, masks, n_props)
             p_evals = p_evals + jnp.where(overflow, zero, d_evals)
@@ -386,6 +493,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                unique, scount, disc, maxdepth, status)
         if checked:
             out = out + (err,)
+        if por is not None:
+            out = out + (boost, pstats)
         return out + tuple(cart)
 
     def cond(state):
@@ -409,6 +518,10 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             ),
             carry[_DISC],
         ]
+        if por is not None:
+            # the reduced-vs-full tallies ride the same packed vector,
+            # right after the discovery fps (before any cartography)
+            parts.append(carry[por_start + 1].astype(jnp.uint64))
         if cartography:
             # the counters ride the SAME packed vector: cartography never
             # adds a second host round-trip per sync.  The depth histogram
@@ -483,6 +596,9 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                  status)
         if checked:
             carry = carry + (jnp.bool_(False),)
+        if por is not None:
+            # boost=0: the init batch is not a growth/resume boundary
+            carry = carry + (jnp.int32(0), jnp.zeros((3,), jnp.int64))
         if cartography:
             # per-step tallies start at zero; the depth histogram is not
             # carried — the init states' depth-0 lanes already sit in
@@ -510,7 +626,8 @@ def _repad_queue(carry_np: list, qalloc: int) -> None:
 
 
 def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
-                 checked: bool, cartography: bool = False) -> tuple:
+                 checked: bool, cartography: bool = False,
+                 por: bool = False) -> tuple:
     """Abstract carry signature of the engine built for these capacities —
     what ahead-of-time compilation (``run_fn.lower(avals).compile()``)
     needs instead of concrete arrays.  Must mirror ``init_fn``'s output
@@ -519,7 +636,7 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
     import jax
 
     width, arity = tensor.width, tensor.max_actions
-    qalloc = qcap + batch * arity
+    qalloc = qcap + batch * arity * (2 if por else 1)
     sds = jax.ShapeDtypeStruct
     avals = (
         sds((cap,), jnp.uint64), sds((cap,), jnp.uint64),
@@ -532,6 +649,8 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
     )
     if checked:
         avals = avals + (sds((), jnp.bool_),)
+    if por:
+        avals = avals + (sds((), jnp.int32), sds((3,), jnp.int64))
     if cartography:
         from ..ops.cartography import cart_carry_shapes
 
@@ -629,7 +748,7 @@ class TpuChecker(WavefrontChecker):
     def _engine_key(self, cap, qcap, batch, cand) -> tuple:
         return (cap, qcap, batch, cand, self._steps, self._target,
                 self._pallas, self._symmetry is not None, self._checked,
-                self._prededup, self._cartography)
+                self._prededup, self._cartography, self._por)
 
     def _build(self, cap, qcap, batch, cand):
         return _build_engine(
@@ -638,12 +757,18 @@ class TpuChecker(WavefrontChecker):
             sym=self._symmetry is not None, cand=cand,
             checked=self._checked, prededup=self._prededup,
             cartography=self._cartography,
+            por=self._por_plan if self._por else None,
         )
+
+    @property
+    def _por_start(self) -> int:
+        """Carry index of the POR tail (boost scalar + stats triple)."""
+        return (_ERR + 1) if self._checked else _ERR
 
     @property
     def _cart_start(self) -> int:
         """Carry index where the cartography counter tail begins."""
-        return (_ERR + 1) if self._checked else _ERR
+        return self._por_start + (2 if self._por else 0)
 
     def _sync_cartography(self, tail, *, states: int, unique: int) -> None:
         """Parse the cartography section of the packed stats vector (the
@@ -669,6 +794,7 @@ class TpuChecker(WavefrontChecker):
             depth_hist=dh, action_hist=ah, prop_evals=pe, prop_hits=ph,
             prop_names=[pr.name for pr in self._props],
             states=states, unique=unique,
+            por=self._live_por if self._por else None,
         )
         self._live_cart = snap
         if self.flight_recorder is not None:
@@ -775,7 +901,7 @@ class TpuChecker(WavefrontChecker):
             if key in cache or self._prewarmer.scheduled(key):
                 continue
             checked, n_props = self._checked, len(self._props)
-            cartography = self._cartography
+            cartography, por = self._cartography, self._por
             tensor = self.tensor
 
             def build(ncap=ncap, nqcap=nqcap, ncand=ncand):
@@ -783,7 +909,7 @@ class TpuChecker(WavefrontChecker):
                 exe = _aot_compile(
                     run_fn,
                     _carry_avals(tensor, n_props, ncap, nqcap, batch,
-                                 checked, cartography),
+                                 checked, cartography, por),
                 )
                 return init_fn, exe
             if self._prewarmer.schedule(key, build):
@@ -836,13 +962,19 @@ class TpuChecker(WavefrontChecker):
         if self._resume is not None:
             self._check_snapshot_sig(self._resume)
 
+    def _qalloc(self, qcap: int, batch: int) -> int:
+        """Queue allocation for these capacities — must mirror the
+        engine's (POR over-allocates a second append window)."""
+        m = batch * self.tensor.max_actions
+        return qcap + (2 * m if self._por else m)
+
     def _snapshot_to_carry(self, snap: dict):
         self._check_snapshot_sig(snap)
         cap = int(snap["cap"])
         qcap = int(snap["qcap"])
         self._batch = int(snap.get("batch", self._batch))
         self._cand = int(snap.get("cand", self._cand))
-        qalloc = qcap + self._batch * self.tensor.max_actions
+        qalloc = self._qalloc(qcap, self._batch)
         base = snap.get("cart_depth_base")
         if base is not None:
             self._cart_depth_base = np.asarray(base, np.int64).copy()
@@ -902,7 +1034,7 @@ class TpuChecker(WavefrontChecker):
         while pending * 2 > qcap:
             qcap *= 2
         carry_np[_STATUS] = np.int32(_STATUS_OK)
-        _repad_queue(carry_np, qcap + batch * arity)
+        _repad_queue(carry_np, self._qalloc(qcap, batch))
         return cap, qcap, carry_np
 
     def _run(self):
@@ -985,6 +1117,13 @@ class TpuChecker(WavefrontChecker):
             if self._checked:
                 # snapshots never carry the error flag: re-seed all-clear
                 carry = list(carry) + [jnp.bool_(False)]
+            if self._por:
+                # a resume IS a snapshot boundary: the proviso arms one
+                # fully expanded batch (boost=1); the reduced-vs-full
+                # tallies restart at zero like the cartography counters
+                carry = list(carry) + [
+                    jnp.int32(1), jnp.zeros((3,), jnp.int64)
+                ]
             if self._cartography:
                 # snapshots never carry the counters either: a resumed run
                 # restarts its per-step tallies at zero (totals keep
@@ -1020,6 +1159,7 @@ class TpuChecker(WavefrontChecker):
         syncs = 0
         disc_len = max(len(self._props), 1)
         cart_start = self._cart_start if self._cartography else None
+        por_start = self._por_start if self._por else None
         if rec is not None:
             rec.update_meta(
                 batch=batch, steps_per_call=self._steps, pallas=self._pallas,
@@ -1027,7 +1167,7 @@ class TpuChecker(WavefrontChecker):
         while True:
             # one host sync per iteration: the packed stats vector
             if stats is None:
-                stats = _stats_np(carry, cart_start)
+                stats = _stats_np(carry, cart_start, por_start)
             head, tail, unique, scount, maxdepth, status = (
                 int(stats[_ST_HEAD]), int(stats[_ST_TAIL]),
                 int(stats[_ST_UNIQUE]), int(stats[_ST_SCOUNT]),
@@ -1037,9 +1177,15 @@ class TpuChecker(WavefrontChecker):
             with self._live_lock:
                 self._live = (scount, unique, maxdepth)
                 self._live_disc = np.asarray(disc)
+            tail_off = _ST_DISC + disc_len
+            if self._por:
+                self._live_por = self._por_stats_dict(
+                    stats[tail_off:tail_off + 3]
+                )
+                tail_off += 3
             if self._cartography:
                 self._sync_cartography(
-                    stats[_ST_DISC + disc_len:], states=scount, unique=unique
+                    stats[tail_off:], states=scount, unique=unique
                 )
             if self._checked and len(carry) > _ERR:
                 # a failed kernel check raises HERE, before any growth or
@@ -1101,6 +1247,9 @@ class TpuChecker(WavefrontChecker):
                 # check above already passed; the counters are
                 # capacity-independent)
                 tail_extra = list(carry[_ERR:])
+                if self._por:
+                    # growth is a boundary: arm one fully expanded batch
+                    tail_extra[self._por_start - _ERR] = jnp.int32(1)
                 carry = list(carry[:_ERR])
                 if status == _STATUS_CAND_FULL:
                     # the candidate budget is an engine parameter, not a
@@ -1175,6 +1324,8 @@ class TpuChecker(WavefrontChecker):
             "disc": np.asarray(disc),
             "depth": maxdepth,
         }
+        if self._por and self._live_por is not None:
+            self._results["por"] = dict(self._live_por)
         if self._cartography and getattr(self, "_live_cart", None):
             self._results["cartography"] = self._live_cart
             if rec is not None:

@@ -28,6 +28,8 @@ import pytest
 import jax
 import numpy as np
 
+from helpers import requires_sharded_collectives
+
 from stateright_tpu.models.dining import dining_model
 from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 from stateright_tpu.telemetry.health import HealthTracker, phase_timeline
@@ -180,10 +182,7 @@ def test_dining_reconciles_and_fills_action_histogram():
 # -- sharded engine ----------------------------------------------------------
 
 
-@pytest.mark.skipif(
-    not (hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")),
-    reason="sharded engine needs vma casts this jax lacks",
-)
+@requires_sharded_collectives
 def test_sharded_cartography_counts_and_shard_extras():
     c = TwoPhaseSys(3).checker().telemetry(cartography=True).spawn_tpu(
         sync=True, devices=2, capacity=1 << 12, frontier_capacity=1 << 9
@@ -201,10 +200,7 @@ def test_sharded_cartography_counts_and_shard_extras():
     assert imb["max"] >= imb["mean"]
 
 
-@pytest.mark.skipif(
-    not (hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")),
-    reason="sharded engine needs vma casts this jax lacks",
-)
+@requires_sharded_collectives
 def test_sharded_resume_preserves_cartography_counters():
     """The sharded counter tail is cumulative IN-CARRY, so snapshots must
     persist it: a resumed run re-seeded with zeros pairs restarted
@@ -225,10 +221,7 @@ def test_sharded_resume_preserves_cartography_counters():
     _reconcile(r)
 
 
-@pytest.mark.skipif(
-    not (hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")),
-    reason="sharded engine needs vma casts this jax lacks",
-)
+@requires_sharded_collectives
 def test_sharded_cartography_off_program_unchanged():
     """Flag-off pin for the sharded engine: the whole-run program traced
     with ``cartography=False`` is bit-identical to a build that never

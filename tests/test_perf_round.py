@@ -25,6 +25,8 @@ import pytest
 
 import jax
 
+from helpers import requires_sharded_collectives
+
 from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 from stateright_tpu.parallel.prewarm import (
     PREWARM_THREAD_NAME,
@@ -101,10 +103,7 @@ def test_prededup_parity_under_growth_and_symmetry():
 
 
 @pytest.mark.slow
-@pytest.mark.skipif(
-    not (hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")),
-    reason="sharded engine needs vma casts this jax lacks",
-)
+@requires_sharded_collectives
 def test_prededup_parity_on_sharded_engine():
     a = TwoPhaseSys(3).checker().spawn_tpu(
         sync=True, devices=2, capacity=1 << 12, frontier_capacity=1 << 9

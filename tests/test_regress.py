@@ -185,6 +185,95 @@ def test_sanitizer_verdict_crash_is_a_failure():
     assert v["clean"] is False and "boom" in v["error"]
 
 
+def test_independence_section_gates_the_verdict(tmp_path, capsys):
+    """--independence mirrors --sanitize: the fleet conflict-matrix gate
+    (docs/analysis.md JX3xx) plus a well-formedness check on the run's
+    flag-gated POR leg — POR must never change paxos counts (its matrix
+    is conservatively all-dependent).  Stale artifacts still exit 2 first
+    and never pay the fleet import."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(
+        {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0}
+    ))
+
+    def clean_fleet(stream=None):
+        print("independence fleet: CLEAN", file=stream)
+        return 0
+
+    def dirty_fleet(stream=None):
+        print("independence fleet: FAILED (JX301)", file=stream)
+        return 1
+
+    rc = r.main([str(run), f"--baseline={base}", "--independence"],
+                fleet=clean_fleet)
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and v["ok"] is True
+    assert v["independence"]["clean"] is True
+
+    rc = r.main([str(run), f"--baseline={base}", "--independence"],
+                fleet=dirty_fleet)
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and v["ok"] is False
+    assert "JX301" in v["independence"]["verdict"]
+
+    # without the flag: untouched, no fleet import
+    rc = r.main([str(run), f"--baseline={base}"])
+    v = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and "independence" not in v
+
+    # staleness wins before the fleet runs
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({"fresh": False}))
+    rc = r.main([str(stale), f"--baseline={base}", "--independence"],
+                fleet=clean_fleet)
+    assert rc == 2
+
+
+def test_independence_por_leg_well_formedness(tmp_path, capsys):
+    """A run artifact carrying the flag-gated POR leg must be well-formed
+    and count-stable vs the full-expansion leg."""
+    r = _load()
+
+    def clean_fleet(stream=None):
+        print("independence fleet: CLEAN", file=stream)
+        return 0
+
+    good = {
+        "fresh": True,
+        "tpu_paxos3_unique": 40000,
+        "tpu_paxos3_por_unique": 40000,
+        "tpu_paxos3_por": {"enabled": False, "fallback": "all-dependent"},
+    }
+    v = r.independence_verdict(good, fleet=clean_fleet)
+    assert v["clean"] is True and v["por_leg"]["ok"] is True
+
+    drifted = dict(good, tpu_paxos3_por_unique=39999)
+    v = r.independence_verdict(drifted, fleet=clean_fleet)
+    assert v["clean"] is False
+    assert any("por unique" in p for p in v["por_leg"]["problems"])
+
+    malformed = dict(good, tpu_paxos3_por=["not-a-dict"])
+    v = r.independence_verdict(malformed, fleet=clean_fleet)
+    assert v["clean"] is False
+
+    # a crashed POR leg (bench recorded only the error key) is a gate
+    # FAILURE, never a silent skip
+    crashed = {"fresh": True, "tpu_paxos3_por_error": "RuntimeError: x"}
+    v = r.independence_verdict(crashed, fleet=clean_fleet)
+    assert v["clean"] is False
+    assert any("crashed" in p for p in v["por_leg"]["problems"])
+
+    # a crash in the fleet runner is a failure, never a skip
+    def broken(stream=None):
+        raise RuntimeError("boom")
+
+    v = r.independence_verdict({}, fleet=broken)
+    assert v["clean"] is False and "boom" in v["error"]
+
+
 def test_stages_section_gates_fresh_runs_only(tmp_path, capsys):
     """--stages: a FRESH run must carry a well-formed per-stage breakdown;
     stored baselines without stages (pre-attribution hardware numbers)
