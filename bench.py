@@ -33,13 +33,15 @@ recorder summary (``stateright_tpu/telemetry/``) embedded as
 ``tpu_paxos3_telemetry`` / ``tpu_2pc7_telemetry`` in the details artifact
 — per-step throughput, dedup ratio, growth events, occupancy, transfer
 volume — so every future perf claim has its time series on record.
-Both legs run with the search-cartography counters on and embed their
-post-run report (``telemetry/report.py``) as ``tpu_paxos3_report`` /
-``tpu_2pc7_report`` plus the raw ``*_cartography`` block, so the numbers
-arrive with the search shape (depth/action mix, property coverage, shard
-balance) that explains them.  ``regress.py`` gates a fresh run's summary
-against BENCH_VALIDATED.json (``--cartography`` for the block's
-well-formedness).
+Both legs run with the search-cartography counters AND the HBM memory
+ledger on, embedding their post-run report (``telemetry/report.py``) as
+``tpu_paxos3_report`` / ``tpu_2pc7_report`` plus the raw
+``*_cartography`` and ``*_memory`` blocks, so the numbers arrive with
+the search shape (depth/action mix, property coverage, shard balance)
+and the memory story (per-buffer footprint, growth-transient forecast,
+device watermark) that explain them.  ``regress.py`` gates a fresh
+run's summary against BENCH_VALIDATED.json (``--cartography`` /
+``--memory`` for the blocks' well-formedness).
 
 ``value``/``vs_baseline`` are recomputed on every emit from whatever
 numbers exist so far.
@@ -316,6 +318,10 @@ def record_validated() -> None:
     # diff search shape (depth/action mix, shard balance) across rounds
     if EXTRAS.get("tpu_paxos3_cartography"):
         doc["tpu_paxos3_cartography"] = EXTRAS["tpu_paxos3_cartography"]
+    # ...and the memory block (regress.py --memory): the validated
+    # number travels with its HBM footprint + growth forecast
+    if EXTRAS.get("tpu_paxos3_memory"):
+        doc["tpu_paxos3_memory"] = EXTRAS["tpu_paxos3_memory"]
     if EXTRAS.get("tpu_phases"):
         doc["tpu_phases"] = EXTRAS["tpu_phases"]
     pallas = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
@@ -610,8 +616,13 @@ def tpu_phase() -> dict:
         # the per-step series is the artifact the perf round needs.
         # Cartography counters ride the step (<=5% pin, well inside the
         # regress tolerance): the headline number and the run report that
-        # explains it come from the SAME run (docs/telemetry.md).
-        b = m3.checker().telemetry(capacity=2048, cartography=True)
+        # explains it come from the SAME run (docs/telemetry.md).  The
+        # memory ledger (host arithmetic only) rides along too, so the
+        # measurement arrives with its HBM footprint + growth forecast —
+        # what regress.py --memory gates.
+        b = m3.checker().telemetry(
+            capacity=2048, cartography=True, memory=True
+        )
         if target:
             b = b.target_states(int(target))
         return b.spawn_tpu(sync=True, **caps)
@@ -626,11 +637,16 @@ def tpu_phase() -> dict:
     _mark("paxos3 timed run done")
     if tpu_p3.flight_recorder is not None:
         summ3 = tpu_p3.flight_recorder.summary()
-        # the cartography block is embedded once as tpu_paxos3_cartography
-        # (the regress.py --cartography contract key) and once inside the
-        # self-contained report — not a third time here
+        # the cartography/memory blocks are embedded once as standalone
+        # tpu_paxos3_cartography / tpu_paxos3_memory (the regress.py
+        # contract keys) and once inside the self-contained report — not
+        # a third time here
         summ3.pop("cartography", None)
+        summ3.pop("memory", None)
         out["tpu_paxos3_telemetry"] = summ3
+        mem3 = tpu_p3.memory()
+        if mem3 is not None:
+            out["tpu_paxos3_memory"] = mem3
         # the per-stage attribution (init-compile / rung-compile /
         # device-step / growth / host) of the TIMED run — the numbers the
         # >=1M states/s chase is driven by (docs/perf.md)
@@ -780,7 +796,8 @@ def tpu_phase() -> dict:
         # changes the step program (and the engine cache key), so a plain
         # warm-up would leave the timed run paying the cold compile
         spawn7 = lambda: (  # noqa: E731
-            t7.checker().telemetry(capacity=2048, cartography=True)
+            t7.checker()
+            .telemetry(capacity=2048, cartography=True, memory=True)
             .spawn_tpu(sync=True, **caps7)
         )
         spawn7()  # warm-up
@@ -791,7 +808,11 @@ def tpu_phase() -> dict:
             summ7 = tpu_t7.flight_recorder.summary()
             summ7.pop("cartography", None)  # embedded as the standalone
             # tpu_2pc7_cartography key and inside the report already
+            summ7.pop("memory", None)  # same rule: standalone key below
             out["tpu_2pc7_telemetry"] = summ7
+            mem7 = tpu_t7.memory()
+            if mem7 is not None:
+                out["tpu_2pc7_memory"] = mem7
             try:
                 from stateright_tpu.telemetry.report import build_report
 

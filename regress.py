@@ -8,6 +8,7 @@ staleness and regressions LOUD:
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
+                      [--memory]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -223,6 +224,75 @@ def cartography_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def memory_verdict(run: dict, baseline: dict) -> dict:
+    """``--memory``: the HBM-ledger section (docs/telemetry.md "Memory
+    ledger").
+
+    A FRESH run must carry a WELL-FORMED ``tpu_paxos3_memory`` block —
+    versioned, with a non-empty per-buffer byte map whose sum reconciles
+    exactly against ``total_bytes``, and a growth forecast whose
+    migration transient is at least the steady footprint (old + new
+    carry live).  A perf number without its memory story cannot drive
+    the billion-state capacity tier.  The baseline's block is attached
+    for comparison when present but NEVER gates: stored baselines
+    predating the memory round have none, and stale artifacts must not
+    trip a fresh run (exactly the ``--stages``/``--cartography`` rule)."""
+    mem = run.get("tpu_paxos3_memory")
+    out: dict = {"present": bool(mem)}
+    problems = []
+    if not mem:
+        problems.append("run carries no tpu_paxos3_memory block")
+    else:
+        if not isinstance(mem.get("v"), int):
+            problems.append("missing schema version v")
+        buffers = mem.get("buffers")
+        total = mem.get("total_bytes")
+        if not isinstance(buffers, dict) or not buffers:
+            problems.append("buffers map empty or malformed")
+        elif not all(
+            isinstance(v, int) and v >= 0 for v in buffers.values()
+        ):
+            problems.append("buffers map carries negative/non-int bytes")
+        if not isinstance(total, int) or total <= 0:
+            problems.append("missing/non-positive total_bytes")
+        elif isinstance(buffers, dict) and buffers:
+            # int-only sum here AND in the message: a mixed-type map
+            # (already flagged above) must yield a verdict, not a
+            # TypeError from the f-string's unfiltered sum
+            bsum = sum(
+                v for v in buffers.values() if isinstance(v, int)
+            )
+            if bsum != total:
+                problems.append(
+                    f"sum(buffers)={bsum} != total_bytes={total}"
+                )
+        nxt = mem.get("next_rung")
+        if not isinstance(nxt, dict):
+            problems.append("missing next_rung forecast")
+        else:
+            tb, trans = nxt.get("total_bytes"), nxt.get("transient_bytes")
+            if not isinstance(tb, int) or not isinstance(trans, int):
+                problems.append("next_rung bytes malformed")
+            elif isinstance(total, int) and trans < max(tb, total):
+                problems.append(
+                    f"next_rung transient {trans} below steady bytes "
+                    "(migration holds old+new carry live)"
+                )
+        out["summary"] = {
+            "v": mem.get("v"),
+            "total_bytes": total,
+            "buffers": len(buffers) if isinstance(buffers, dict) else 0,
+            "next_transient_bytes": (
+                (mem.get("next_rung") or {}).get("transient_bytes")
+            ),
+        }
+    out["ok"] = not problems
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_paxos3_memory"))
+    return out
+
+
 def stage_verdict(run: dict, baseline: dict) -> dict:
     """``--stages``: the per-stage attribution section (docs/perf.md).
 
@@ -256,7 +326,7 @@ def main(argv=None, fleet=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
-    stages = cartography = independence = False
+    stages = cartography = independence = memory = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -273,6 +343,8 @@ def main(argv=None, fleet=None) -> int:
             cartography = True
         elif a == "--independence":
             independence = True
+        elif a == "--memory":
+            memory = True
         else:
             pos.append(a)
     if pos:
@@ -316,6 +388,12 @@ def main(argv=None, fleet=None) -> int:
         # stale artifacts never trip
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["cartography"]["ok"]
+    if memory:
+        verdict["memory"] = memory_verdict(run, baseline)
+        # same freshness rule again: stale artifacts and pre-memory
+        # baselines never trip
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["memory"]["ok"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -364,6 +442,18 @@ def main(argv=None, fleet=None) -> int:
             "regress: fresh run carries no (or malformed) search "
             "cartography (tpu_paxos3_cartography) — a perf number without "
             "the search shape behind it cannot be interpreted "
+            "(docs/telemetry.md)\n"
+        )
+        return 1
+    if (
+        "memory" in verdict
+        and verdict["fresh"]
+        and not verdict["memory"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: fresh run carries no (or malformed) memory-ledger "
+            "block (tpu_paxos3_memory) — a perf number without its HBM "
+            "footprint cannot drive the capacity tier "
             "(docs/telemetry.md)\n"
         )
         return 1

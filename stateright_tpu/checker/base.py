@@ -85,6 +85,8 @@ class CheckerBuilder:
         profile_steps: int = 0,
         profile_dir: Optional[str] = None,
         cartography: bool = False,
+        memory: bool = False,
+        memory_every: int = 32,
     ) -> "CheckerBuilder":
         """Attach a flight recorder to the spawned checker
         (``stateright_tpu/telemetry/``; schema in ``docs/telemetry.md``).
@@ -108,6 +110,18 @@ class CheckerBuilder:
         ``profile_steps=N`` arms a scoped ``jax.profiler`` trace of the
         first N hot steps into ``profile_dir`` (device engines only).
 
+        ``memory=True`` attaches the HBM memory ledger
+        (``telemetry/memory.py``, docs/telemetry.md "Memory ledger"):
+        per-buffer analytic byte accounting for the device-resident
+        carry, a growth-transient forecast feeding the health model's
+        ``growth_oom_risk`` condition, live ``device.memory_stats()``
+        readings where the backend has them, and ``memory`` ring records
+        at growth boundaries plus a watermark sample every
+        ``memory_every`` host syncs.  Pure host arithmetic over shapes
+        the engines already know — zero ops added to the step jaxpr
+        either way (pinned by test, the strongest form of the contract
+        below).  ``report()`` implies it.
+
         ``cartography=True`` additionally folds the search-cartography
         counters into the device step (``ops/cartography.py``,
         docs/telemetry.md): per-depth frontier sizes, the per-action
@@ -125,11 +139,22 @@ class CheckerBuilder:
         if not enabled:
             self.telemetry_opts = None
             return self
-        # A cartography flag implied earlier (``.report()``/``.cartography()``)
-        # is sticky: reconfiguring the recorder must not silently drop the
-        # counters the report contract depends on.
+        # Flags implied earlier (``.report()``/``.cartography()``/
+        # ``.memory_ledger()``) are sticky: reconfiguring the recorder
+        # must not silently drop the counters/ledger the report contract
+        # depends on.
         implied_cart = bool(self.telemetry_opts) and bool(
             self.telemetry_opts.get("cartography")
+        )
+        implied_mem = bool(self.telemetry_opts) and bool(
+            self.telemetry_opts.get("memory")
+        )
+        # a previously configured cadence is part of the sticky ledger
+        # config: keep it unless this call sets one explicitly
+        prev_every = (
+            self.telemetry_opts.get("memory_every")
+            if implied_mem and memory_every == 32
+            else None
         )
         self.telemetry_opts = {
             "capacity": capacity,
@@ -137,6 +162,10 @@ class CheckerBuilder:
             "profile_steps": profile_steps,
             "profile_dir": profile_dir,
             "cartography": bool(cartography) or implied_cart,
+            "memory": bool(memory) or implied_mem,
+            "memory_every": int(
+                prev_every if prev_every is not None else memory_every
+            ),
         }
         return self
 
@@ -153,14 +182,29 @@ class CheckerBuilder:
         self.telemetry_opts["cartography"] = True
         return self
 
+    def memory_ledger(self, enabled: bool = True) -> "CheckerBuilder":
+        """Attach the HBM memory ledger (``telemetry/memory.py``) — a
+        ``.telemetry(memory=True)`` shorthand that composes with an
+        existing telemetry config instead of replacing it.  ``report()``
+        and the CLI ``--watch`` flag imply it."""
+        if not enabled:
+            return self
+        if self.telemetry_opts is None:
+            self.telemetry()
+        self.telemetry_opts["memory"] = True
+        self.telemetry_opts.setdefault("memory_every", 32)
+        return self
+
     def report(self, path: str) -> "CheckerBuilder":
         """Write a post-run report to ``path`` (JSON; a sibling ``.md``
         rendering lands next to it) at the first ``join()`` after the run
         completes — the artifact a human reads after an unattended on-chip
         run (``stateright_tpu/telemetry/report.py``; docs/telemetry.md
-        "Reading a run report").  Implies telemetry with cartography: the
-        report combines the run totals, the cartography block, the health
-        timeline, growth events, and the audit/sanitizer status.  The JSON
+        "Reading a run report").  Implies telemetry with cartography AND
+        the memory ledger: the report combines the run totals, the
+        cartography block, the memory block (analytic — deterministic),
+        the health timeline, growth events, and the audit/sanitizer
+        status.  The JSON
         body is deterministic for a fixed model/config — wall-clock-
         dependent values live in the markdown rendering only, and the
         single volatile JSON field is the ``generated_at`` header."""
@@ -172,7 +216,7 @@ class CheckerBuilder:
                 "the markdown rendering lands next to it as <path-stem>.md"
             )
         self.report_path = str(path)
-        return self.cartography()
+        return self.cartography().memory_ledger()
 
     def prewarm(self, enabled: bool = True) -> "CheckerBuilder":
         """Growth-stall elision for the single-device wavefront engine

@@ -14,7 +14,7 @@ HTTP surface mirrors the reference server (``src/checker/explorer.rs``):
    fingerprints give 404 (``explorer.rs:233-237``).
  - ``GET /.metrics`` — live flight-recorder telemetry (beyond the
    reference): ``{summary, series, occupancy, counters, health,
-   cartography}`` for runs spawned with ``.telemetry()``
+   cartography, memory}`` for runs spawned with ``.telemetry()``
    (``stateright_tpu/telemetry/``); telemetry off returns a stable JSON
    error body ``{"error": "telemetry_disabled", "hint": ...}`` with 404.
    The UI draws throughput/occupancy sparklines and the cartography
@@ -213,6 +213,11 @@ def _metrics_view(checker) -> Optional[dict]:
         "counters": rec.counters(),
         "health": rec.health(),
         "cartography": rec.cartography(),
+        # HBM ledger block (telemetry/memory.py): analytic footprint +
+        # growth forecast + live device stats; null unless the run was
+        # spawned with .telemetry(memory=True).  The UI's headroom panel
+        # reads it.
+        "memory": rec.memory(),
     }
 
 

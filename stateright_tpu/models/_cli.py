@@ -42,6 +42,7 @@ def run_cli(
     sanitize: Optional[Callable[[list], None]] = None,
     report: Optional[Callable[[list], None]] = None,
     independence: Optional[Callable[[list], None]] = None,
+    capacity: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -71,6 +72,8 @@ def run_cli(
         report(rest)
     elif cmd == "independence" and independence is not None:
         independence(rest)
+    elif cmd == "capacity" and capacity is not None:
+        capacity(rest)
     else:
         print("USAGE:")
         print(usage)
@@ -94,6 +97,9 @@ def run_cli(
         if report is not None:
             print("  <example> report [--out=F] [ARGS]  "
                   "# post-run report: JSON + markdown (docs/telemetry.md)")
+        if capacity is not None:
+            print("  <example> capacity [ARGS]  # HBM capacity plan: "
+                  "analytic footprint per growth rung (docs/telemetry.md)")
 
 
 def pop_checked(rest: list) -> tuple:
@@ -159,16 +165,18 @@ def pop_watch(rest: list) -> tuple:
 
 def apply_watch(builder, watch: bool):
     """Arm a builder for the live watch view: the status line reads the
-    health model and cartography block, so ``--watch`` implies
-    ``.telemetry(cartography=True)`` (docs/telemetry.md)."""
+    health model, the cartography block, and the HBM ledger, so
+    ``--watch`` implies ``.telemetry(cartography=True, memory=True)``
+    (docs/telemetry.md)."""
     if not watch:
         return builder
-    return builder.cartography()
+    return builder.cartography().memory_ledger()
 
 
 def watch_line(checker) -> str:
     """One live status line: depth, cumulative counters, smoothed
-    throughput, table load, health phase (+ stall flag), drain ETA."""
+    throughput, table load, HBM footprint (vs the device budget when one
+    is known), health phase (+ stall / OOM-risk flags), drain ETA."""
     rec = checker.flight_recorder
     h = rec.health() if rec is not None else {}
     last = (rec.last_step() if rec is not None else None) or {}
@@ -181,13 +189,36 @@ def watch_line(checker) -> str:
         f"unique={checker.unique_state_count()}",
         f"states/s={sps if sps is not None else '-'}",
         f"load={load if load is not None else '-'}",
+        f"hbm={_watch_hbm(rec)}",
         f"phase={h.get('phase', '-')}",
     ]
     if h.get("stalled"):
         parts.append(f"STALLED({h.get('stall_reason') or '?'})")
+    if h.get("oom_risk"):
+        parts.append("OOM-RISK(next growth rung does not fit)")
     if h.get("eta_secs") is not None:
         parts.append(f"eta={h['eta_secs']}s")
     return " ".join(parts)
+
+
+def _watch_hbm(rec) -> str:
+    """The ``hbm=`` column: live device bytes when the backend reports
+    them, else the ledger's analytic carry bytes; '/budget (x%)' when a
+    budget is known.  '-' when the run has no memory ledger."""
+    mem = rec.memory() if rec is not None else None
+    if not mem:
+        return "-"
+    from ..telemetry.memory import fmt_bytes
+
+    live = mem.get("device") or {}
+    used = live.get("bytes_in_use", mem.get("total_bytes"))
+    budget = mem.get("budget_bytes")
+    if budget:
+        return (
+            f"{fmt_bytes(used)}/{fmt_bytes(budget)}"
+            f"({100.0 * used / budget:.1f}%)"
+        )
+    return fmt_bytes(used)
 
 
 def watch_checker(
@@ -471,6 +502,143 @@ def fleet_independence(names: Optional[list] = None, stream=None) -> int:
     return 0 if ok else 1
 
 
+# -- capacity verb -----------------------------------------------------------
+
+
+def capacity_and_report(models: Iterable[tuple], stream=None) -> bool:
+    """HBM capacity plan over ``(label, model)`` pairs
+    (``telemetry/memory.py``; docs/telemetry.md "Memory ledger"): the
+    analytic per-rung footprint ladder of the wavefront engine at its
+    default spawn capacities, the growth-migration transient per rung,
+    and — when a device budget is known (live ``memory_stats`` or the
+    ``STATERIGHT_TPU_DEVICE_BYTES`` override) — the max reachable unique
+    count before the run would spill.  Pure host arithmetic: no device
+    run, no compile; on CPU (no budget) it degrades to the analytic
+    table alone, never crashes.  Returns True iff every configuration
+    produced a plan (twin-less models are reported and skipped)."""
+    from ..parallel.tensor_model import twin_or_none
+    from ..telemetry.memory import (
+        capacity_plan,
+        device_budget,
+        fmt_bytes,
+        wavefront_specs,
+    )
+
+    stream = stream or sys.stdout
+    budget, src = device_budget()
+    ok = True
+    for label, model in models:
+        print(f"--- {label}", file=stream)
+        twin = twin_or_none(model)
+        if twin is None:
+            print(
+                "capacity: no device twin for this configuration "
+                "(host checkers hold states in host RAM)",
+                file=stream,
+            )
+            continue
+        n_props = len(list(model.properties()))
+        # the wavefront engine's default spawn capacities
+        # (parallel/wavefront.TpuChecker): the ladder starts where an
+        # un-tuned spawn_tpu() starts
+        cap, batch = 1 << 17, 1 << 11
+        caps = {"cap": cap, "qcap": max(cap // 2, 4 * batch),
+                "batch": batch}
+
+        def spec_fn(c, twin=twin, n_props=n_props):
+            return wavefront_specs(
+                twin, n_props, int(c["cap"]), int(c["qcap"]),
+                int(c["batch"]),
+            )
+
+        try:
+            plan = capacity_plan(
+                spec_fn, caps, budget=budget,
+                rungs=24 if budget is not None else 10,
+            )
+        except Exception as e:  # noqa: BLE001 - a plan failure is a
+            # verdict, not a crash (the CI smoke's contract)
+            ok = False
+            print(f"capacity: plan failed: {type(e).__name__}: {e}",
+                  file=stream)
+            continue
+        if budget is not None:
+            print(
+                f"capacity plan (wavefront engine; device budget "
+                f"{fmt_bytes(budget)}, {src}):",
+                file=stream,
+            )
+        else:
+            print(
+                "capacity plan (wavefront engine; no device memory "
+                "limit known — analytic footprint only; set "
+                "STATERIGHT_TPU_DEVICE_BYTES to plan against a budget):",
+                file=stream,
+            )
+        print(f"  {'capacity':>12}  {'carry':>9}  {'transient':>9}  fits",
+              file=stream)
+        for r in plan["rungs"]:
+            fits = r.get("fits")
+            print(
+                f"  {r['capacity']:>12}  {fmt_bytes(r['total_bytes']):>9}"
+                f"  {fmt_bytes(r['transient_bytes']):>9}  "
+                f"{'-' if fits is None else ('yes' if fits else 'NO')}",
+                file=stream,
+            )
+        if plan.get("max_unique") is not None:
+            print(
+                f"on this device, {label} reaches ~{plan['max_unique']:,} "
+                "unique states before spilling (largest rung whose "
+                "growth transient fits; spill tier: ROADMAP "
+                "billion-state item)",
+                file=stream,
+            )
+        elif budget is not None:
+            print(
+                f"on this device, {label} cannot hold even the first "
+                "rung — shrink capacity= or raise the budget",
+                file=stream,
+            )
+    return ok
+
+
+def make_capacity_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as a ``capacity``
+    CLI verb (exit 1 only when the plan itself crashes)."""
+
+    def _capacity(rest: list) -> None:
+        if not capacity_and_report(factory(rest)):
+            raise SystemExit(1)
+
+    return _capacity
+
+
+def fleet_capacity(names: Optional[list] = None, stream=None) -> int:
+    """Capacity-plan the whole example fleet (or just ``names``); 0 iff
+    every module's configurations produced a plan (twin-less models are
+    disclosed, not failures — host checkers have no device footprint)."""
+    import importlib
+
+    from . import __all__ as all_names
+
+    stream = stream or sys.stdout
+    ok = True
+    for name in names or list(all_names):
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+        factory = getattr(mod, "_audit_models", None)
+        if factory is None:
+            print(
+                f"--- {name}: FAILED — no _audit_models hook (add one so "
+                "the fleet gate covers this example)",
+                file=stream,
+            )
+            ok = False
+            continue
+        ok = capacity_and_report(factory([]), stream=stream) and ok
+    print("capacity fleet: " + ("OK" if ok else "FAILED"), file=stream)
+    return 0 if ok else 1
+
+
 # -- profile verb ------------------------------------------------------------
 
 
@@ -699,6 +867,8 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_profile(argv[1:]))
     if argv and argv[0] == "report":
         raise SystemExit(fleet_report(argv[1:]))
+    if argv and argv[0] == "capacity":
+        raise SystemExit(fleet_capacity(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
@@ -716,7 +886,10 @@ def main(argv: Optional[list] = None) -> None:
     print("  python -m stateright_tpu.models._cli report [MODULE] "
           "[--out=F] [ARGS...]")
     print("    post-run report (JSON + markdown): totals, cartography, "
-          "health timeline (docs/telemetry.md)")
+          "memory, health timeline (docs/telemetry.md)")
+    print("  python -m stateright_tpu.models._cli capacity [MODULE...]")
+    print("    HBM capacity plan over the fleet: analytic per-rung "
+          "footprint + max reachable states (docs/telemetry.md)")
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ from ._cli import (
     default_threads,
     make_audit_cmd,
     make_profile_cmd,
+    make_capacity_cmd,
     make_report_cmd,
     make_independence_cmd,
     make_sanitize_cmd,
@@ -125,6 +126,7 @@ def main(argv=None):
         independence=make_independence_cmd(_audit_models),
         profile=make_profile_cmd(_audit_models),
         report=make_report_cmd(_audit_models),
+        capacity=make_capacity_cmd(_audit_models),
         argv=argv,
     )
 

@@ -173,6 +173,29 @@ class WavefrontChecker(Checker):
         self._report_written = False
         tag = "wavefront" if self._engine_tag == "single" else self._engine_tag
         self.flight_recorder = options._make_recorder(tag)
+        # HBM memory ledger (telemetry/memory.py): per-buffer analytic
+        # accounting + growth-transient forecast + live device readings.
+        # Pure host arithmetic over shapes the engines already know —
+        # zero device ops, zero jaxpr change either way (pinned by test).
+        self._mem_ledger = None
+        if (
+            self.flight_recorder is not None
+            and self._telemetry_opts.get("memory")
+        ):
+            from ..telemetry.memory import MemoryLedger
+
+            self._mem_ledger = MemoryLedger(
+                tag,
+                self._memory_spec_fn(),
+                recorder=self.flight_recorder,
+                every=int(self._telemetry_opts.get("memory_every") or 0),
+                extra=self._memory_extra(),
+            )
+        # preflight capacity guard: cheap analytic math, always on (warn;
+        # STATERIGHT_TPU_CAPACITY_GUARD=error escalates, =off silences) —
+        # a run whose requested table cannot fit the device should say so
+        # BEFORE any compile is paid.  Silent where no budget is known.
+        self._preflight_capacity_guard()
         self._profiler = None
         if (
             self.flight_recorder is not None
@@ -246,6 +269,58 @@ class WavefrontChecker(Checker):
     def _pre_run_validate(self) -> None:  # engine-specific, optional
         pass
 
+    # -- memory ledger hooks (telemetry/memory.py) ---------------------------
+
+    def _memory_spec_fn(self):
+        """``caps -> [BufferSpec]`` analytic model; engine-specific."""
+        raise NotImplementedError
+
+    def _memory_caps(self) -> dict:
+        """The engine's CONFIGURED capacities as a spec-fn caps dict."""
+        raise NotImplementedError
+
+    def _memory_extra(self) -> dict:
+        """Engine-shape annotations for the ledger snapshot."""
+        return {}
+
+    def _analytic_footprint_bytes(self, caps: Optional[dict] = None):
+        """Total analytic bytes of the device-resident carry at ``caps``
+        (default: the configured capacities); None when the model cannot
+        be built (accounting must never break a run)."""
+        from ..telemetry.memory import total_bytes
+
+        try:
+            fn = self._memory_spec_fn()
+            return int(total_bytes(fn(caps or self._memory_caps())))
+        except Exception:  # noqa: BLE001 - accounting only
+            return None
+
+    def _preflight_capacity_guard(self) -> None:
+        from ..telemetry.memory import preflight_guard
+
+        total = self._analytic_footprint_bytes()
+        if total is None:
+            return
+        preflight_guard(
+            f"spawn_tpu({type(self.model).__name__})",
+            total,
+            warn_once_obj=self.model,
+        )
+
+    def memory(self, live: bool = True) -> Optional[dict]:
+        """Latest memory-ledger snapshot (``telemetry/memory.py``), or
+        None when the run was spawned without ``.telemetry(memory=True)``.
+        ``live=False`` returns the DETERMINISTIC analytic subset (the run
+        report's memory block: no device stats, no machine-local
+        budget)."""
+        if self._mem_ledger is None:
+            return None
+        return (
+            self._mem_ledger.snapshot()
+            if live
+            else self._mem_ledger.analytic_block()
+        )
+
     def _model_sig(self) -> np.ndarray:
         """Model identity guard for resume: init fingerprints alone can
         coincide across configurations (e.g. all-zero init rows), so the
@@ -273,6 +348,19 @@ class WavefrontChecker(Checker):
             raise ValueError(
                 "resume snapshot was taken from a different model "
                 "(init fingerprints / tensor signature disagree)"
+            )
+        # snapshot-manifest capacity check (telemetry/memory.py): the
+        # snapshot records its analytic footprint (older ones fall back
+        # to summed array bytes) — warn/flag-gated-error BEFORE any
+        # compile when the target device analytically cannot hold it.
+        # Once per checker: the wavefront path validates the same
+        # snapshot twice (preflight + carry materialization).
+        if not getattr(self, "_snapshot_fit_checked", False):
+            self._snapshot_fit_checked = True
+            from ..telemetry.memory import snapshot_fits_guard
+
+            snapshot_fits_guard(
+                snap, f"resume({type(self.model).__name__})"
             )
 
     def _stage(self, name: str, secs: float) -> None:

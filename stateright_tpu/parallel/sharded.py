@@ -720,6 +720,43 @@ class ShardedTpuChecker(WavefrontChecker):
             self._gather_fn = gather  # one compile serves both tables
         return np.asarray(jax.device_get(gather(sharded)))
 
+    # -- memory-ledger hooks (telemetry/memory.py) ---------------------------
+
+    def _memory_spec_fn(self):
+        """Analytic model of this engine's GLOBAL carry (logical array
+        shapes; the snapshot's ``per_device_bytes`` divides the sharded
+        buffers over the mesh).  Caps key ``cap`` is the GLOBAL table
+        slot count — the growth forecast doubles it, exactly as a
+        table-overflow doubles every shard."""
+        from ..telemetry.memory import sharded_specs
+
+        width, arity = self.tensor.width, self.tensor.max_actions
+        n_props, ndev = len(self._props), self.ndev
+        cart, por = self._cartography, self._por
+        fcap_default = self._fcap_local
+
+        def spec_fn(caps):
+            return sharded_specs(
+                width, arity, n_props, ndev,
+                max(int(caps["cap"]) // ndev, 1),
+                int(caps.get("fcap_local", fcap_default)),
+                cartography=cart, por=por,
+            )
+
+        return spec_fn
+
+    def _memory_caps(self) -> dict:
+        return {
+            "cap": self._cap_local * self.ndev,
+            "fcap_local": self._fcap_local,
+        }
+
+    def _memory_extra(self) -> dict:
+        return {
+            "devices": self.ndev,
+            "frontier_capacity": self._fcap_local * self.ndev,
+        }
+
     def _cart_zero_host(self) -> list:
         """Fresh host-side cartography counter buffers in carry-tail order
         (depth/action/property tallies + per-shard load and route matrix);
@@ -867,6 +904,13 @@ class ShardedTpuChecker(WavefrontChecker):
         snap["cand_factor"] = cf
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
+        # snapshot manifest: analytic footprint at these capacities, for
+        # the resume-time fits guard (parallel/_base._check_snapshot_sig)
+        fb = self._analytic_footprint_bytes(
+            {"cap": cap * self.ndev, "fcap_local": fcap}
+        )
+        if fb is not None:
+            snap["footprint_bytes"] = np.int64(fb)
         return snap
 
     @property
@@ -1210,6 +1254,13 @@ class ShardedTpuChecker(WavefrontChecker):
                             self._host_table(carry[0]),
                             at=f"sync{syncs}", transferred=True,
                         )
+                    if self._mem_ledger is not None:
+                        self._mem_ledger.observe(
+                            {"cap": cap * self.ndev, "fcap_local": fcap},
+                            extra={
+                                "frontier_capacity": fcap * self.ndev,
+                            },
+                        )
                 if self._ckpt_req is not None and self._ckpt_req.is_set():
                     self._ckpt_out = self._carry_to_snapshot(
                         carry, more, cap, fcap, bf, cf
@@ -1314,6 +1365,8 @@ class ShardedTpuChecker(WavefrontChecker):
             self._telemetry_occupancy(
                 self._results["table_fp"], at="final", transferred=False
             )
+        if self._mem_ledger is not None:
+            self._mem_ledger.finalize()
         if rec is not None:
             rec.close_run(done=not self._timed_out)
         # keep the final carry device-resident; a stopped run's snapshot

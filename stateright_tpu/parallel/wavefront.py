@@ -760,6 +760,34 @@ class TpuChecker(WavefrontChecker):
             por=self._por_plan if self._por else None,
         )
 
+    # -- memory-ledger hooks (telemetry/memory.py) ---------------------------
+
+    def _memory_spec_fn(self):
+        """Analytic per-buffer model of THIS engine's carry: derived from
+        ``_carry_avals`` (the prewarm-AOT signature), so the bytes
+        reconcile exactly against the live buffers (pinned by test)."""
+        from ..telemetry.memory import wavefront_specs
+
+        tensor, n_props = self.tensor, len(self._props)
+        checked, cart, por = self._checked, self._cartography, self._por
+        batch = self._batch
+
+        def spec_fn(caps):
+            return wavefront_specs(
+                tensor, n_props, int(caps["cap"]),
+                int(caps.get("qcap", max(int(caps["cap"]) // 2, 1))),
+                int(caps.get("batch", batch)),
+                checked=checked, cartography=cart, por=por,
+            )
+
+        return spec_fn
+
+    def _memory_caps(self) -> dict:
+        return {"cap": self._cap, "qcap": self._qcap, "batch": self._batch}
+
+    def _memory_extra(self) -> dict:
+        return {"queue_capacity": self._qcap}
+
     @property
     def _por_start(self) -> int:
         """Carry index of the POR tail (boost scalar + stats triple)."""
@@ -837,14 +865,20 @@ class TpuChecker(WavefrontChecker):
                 # growth-stall elision the prewarm exists for)
                 self._stage("compile", waited)
                 if rec is not None:
-                    rec.add("prewarm_consumed")
-                    rec.record(
+                    ev = rec.record(
                         "compile", cap=cap, qcap=qcap, batch=batch,
                         cand=cand, rung=kind, source="prewarm",
                         cache_hit=True, prewarm_ready=was_ready,
                         duration=round(waited, 6),
                         build_secs=round(job.compile_secs, 6),
                     )
+                    rec.add("prewarm_consumed")
+                    if self._mem_ledger is not None:
+                        # the prewarmed executable is at hand: capture its
+                        # compile-time memory analysis onto the event
+                        mem = self._mem_ledger.attach_exec(eng[1])
+                        if mem:
+                            rec.amend(ev, memory=mem)
                 self._schedule_prewarm(cap, qcap, batch, cand)
                 return eng
         if rec is not None:
@@ -855,6 +889,43 @@ class TpuChecker(WavefrontChecker):
                 rung=kind, source="fresh", cache_hit=False, duration=0.0,
             )
         eng = self._build(cap, qcap, batch, cand)
+        if self._mem_ledger is not None:
+            # With the ledger on, the fresh path compiles the run program
+            # AHEAD OF TIME (the same executable the lazy path would
+            # build — the prewarm contract, pinned by its tests) so the
+            # executable handle exists and its compile-time memory
+            # analysis can be captured; the wait is paid HERE instead of
+            # at the first device call, and lands on the same compile
+            # event via amend() (init_fn's lazy compile still accumulates
+            # there afterwards).  Persistent-cache hits flow through this
+            # path too and are detected by the monitoring delta.
+            watch = CompileWatch()
+            t0 = time.monotonic()
+            try:
+                exe = _aot_compile(
+                    eng[1],
+                    _carry_avals(
+                        self.tensor, len(self._props), cap, qcap, batch,
+                        self._checked, self._cartography, self._por,
+                    ),
+                )
+            except Exception:  # noqa: BLE001 - fall back to the lazy path;
+                exe = None  # accounting must never break a run
+            if exe is not None:
+                build = time.monotonic() - t0
+                self._stage("compile", build)
+                eng = (eng[0], exe)
+                mem = self._mem_ledger.attach_exec(exe)
+                if rec is not None and self._pending_compile_rec is not None:
+                    d = watch.delta()
+                    hit = d["persistent_hits"] > 0
+                    fields = dict(
+                        duration=round(build, 6), cache_hit=hit,
+                        source="persistent" if hit else "fresh",
+                    )
+                    if mem:
+                        fields["memory"] = mem
+                    rec.amend(self._pending_compile_rec, **fields)
         cache[key] = eng
         return eng
 
@@ -951,6 +1022,15 @@ class TpuChecker(WavefrontChecker):
         snap["width"] = self.tensor.width
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
+        # snapshot manifest (telemetry/memory.py): the analytic byte
+        # footprint at these capacities travels with the snapshot, so a
+        # resume on a smaller device can warn BEFORE compiling
+        # (_check_snapshot_sig -> snapshot_fits_guard)
+        fb = self._analytic_footprint_bytes(
+            {"cap": cap, "qcap": qcap, "batch": self._batch}
+        )
+        if fb is not None:
+            snap["footprint_bytes"] = np.int64(fb)
         if self._cart_depth_base is not None:
             # depth lanes banked by growth compactions (_grow): without
             # them a resumed histogram forgets every state popped before
@@ -1206,6 +1286,14 @@ class TpuChecker(WavefrontChecker):
                     self._telemetry_occupancy(
                         carry[_TFP], at=f"sync{syncs}", transferred=True
                     )
+                if self._mem_ledger is not None:
+                    # rung changes emit a ``memory`` ring record (the
+                    # per-growth series); otherwise this is a cheap dict
+                    # compare plus the periodic watermark sample
+                    self._mem_ledger.observe(
+                        {"cap": cap, "qcap": qcap, "batch": batch},
+                        extra={"queue_capacity": qcap},
+                    )
             # serve a pending checkpoint BEFORE growing: a request landing on
             # a growth boundary snapshots the boundary carry (status != OK),
             # and resume re-applies the growth (the flag travels with the
@@ -1330,6 +1418,9 @@ class TpuChecker(WavefrontChecker):
             self._results["cartography"] = self._live_cart
             if rec is not None:
                 rec.record("cartography", at="final", **self._live_cart)
+        if self._mem_ledger is not None:
+            # close the memory time series (fresh live watermark)
+            self._mem_ledger.finalize()
         if rec is not None:
             # a deadline-cut run stopped; it did not finish — leave the
             # health phase where the run actually was

@@ -10,7 +10,14 @@ Chrome-trace layout (`chrome://tracing` / Perfetto "JSON object format"):
 ``step`` records become complete events (``ph: "X"``) whose duration is the
 step's ``dt``; point events (growth, occupancy, compile) become instant
 events (``ph: "i"``); aggregate counters ride a final metadata event.
-Timestamps are microseconds, as the format requires.
+Resource pressure rides COUNTER tracks (``ph: "C"`` — the viewer plots
+them as stacked series over the timeline): ``throughput``
+(states_per_sec + load_factor, per step), ``pressure`` (queue depth +
+table load, per step), and ``hbm_bytes`` (the memory ledger's analytic
+bytes + live ``bytes_in_use``, one point per ``memory`` record) — so a
+growth transient or a queue ramp is visible in the same view as the
+steps that caused it.  Timestamps are microseconds, as the format
+requires.
 """
 
 from __future__ import annotations
@@ -143,6 +150,51 @@ def to_chrome_trace(rec: FlightRecorder, path) -> None:
                     "ts": round(ts_us, 3),
                     "pid": pid,
                     "args": counters,
+                })
+            # resource-pressure counter track: queue depth + table load
+            # per step, so the timeline shows WHERE the memory pressure
+            # built, not just that it did (docs/telemetry.md)
+            pressure = {}
+            if r.get("queue") is not None:
+                pressure["queue"] = r["queue"]
+            if r.get("load_factor") is not None:
+                pressure["table_load"] = r["load_factor"]
+            if pressure:
+                events.append({
+                    "name": "pressure",
+                    "cat": "step",
+                    "ph": "C",
+                    "ts": round(ts_us, 3),
+                    "pid": pid,
+                    "args": pressure,
+                })
+        elif r["kind"] == "memory":
+            # memory-ledger samples: the instant event keeps the full
+            # record browsable, the counter track plots the byte series
+            events.append({
+                "name": r["kind"],
+                "cat": r["kind"],
+                "ph": "i",
+                "s": "p",
+                "ts": round(ts_us, 3),
+                "pid": pid,
+                "tid": 1,
+                "args": args,
+            })
+            hbm = {}
+            if r.get("total_bytes") is not None:
+                hbm["analytic_bytes"] = r["total_bytes"]
+            live = r.get("device") or {}
+            if live.get("bytes_in_use") is not None:
+                hbm["bytes_in_use"] = live["bytes_in_use"]
+            if hbm:
+                events.append({
+                    "name": "hbm_bytes",
+                    "cat": "memory",
+                    "ph": "C",
+                    "ts": round(ts_us, 3),
+                    "pid": pid,
+                    "args": hbm,
                 })
         else:
             events.append({

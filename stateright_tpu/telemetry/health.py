@@ -31,6 +31,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .memory import OOM_RISK_LOAD
+
 # health snapshot / event schema version
 HEALTH_V = 1
 
@@ -55,6 +57,13 @@ class HealthTracker:
         self.phase = "expanding"
         self.stalled = False
         self.stall_reason: Optional[str] = None
+        # growth-OOM risk (telemetry/memory.py): armed by the memory
+        # ledger's forecast — the next growth rung's migration transient
+        # vs the device budget; flagged once the table load is close
+        # enough to the growth trigger that the migration is imminent
+        self.oom_risk = False
+        self._mem_next_transient: Optional[int] = None
+        self._mem_budget: Optional[int] = None
         self._zero_novel = 0  # consecutive d_unique == 0 steps
         self._pinned = 0  # consecutive load-at-threshold steps
         self._peak_d_unique = 0
@@ -69,6 +78,20 @@ class HealthTracker:
         self._prev_queue: Optional[float] = None
 
     # -- feeding -------------------------------------------------------------
+
+    def set_memory_forecast(
+        self,
+        next_transient_bytes: Optional[int],
+        budget_bytes: Optional[int],
+    ) -> None:
+        """Arm the ``growth_oom_risk`` condition with the memory ledger's
+        forecast (``telemetry/memory.py``): the next table rung's
+        migration transient and the device budget.  Either value absent
+        (CPU, ledger off) disarms the condition entirely."""
+        self._mem_next_transient = (
+            int(next_transient_bytes) if next_transient_bytes else None
+        )
+        self._mem_budget = int(budget_bytes) if budget_bytes else None
 
     def update(self, rec: dict) -> list:
         """Fold one step record in; returns the ``health`` EVENTS to emit
@@ -125,7 +148,29 @@ class HealthTracker:
         elif self._pinned >= self.stall_after:
             stalled, reason = True, "load_pinned_at_growth_threshold"
 
+        # growth-OOM risk: the table load has crossed half-way to the
+        # growth trigger (the migration is imminent, not hypothetical)
+        # and the ledger's forecast says the next rung's transient does
+        # not fit the device.  A *flag with a forecast*, like the stall:
+        # the run keeps going, but the operator should checkpoint or
+        # re-plan before the growth boundary hits the wall.
+        oom = bool(
+            self._mem_next_transient
+            and self._mem_budget
+            and load is not None
+            and float(load) >= OOM_RISK_LOAD
+            and self._mem_next_transient > self._mem_budget
+        )
+
         events = []
+        if oom != self.oom_risk:
+            self.oom_risk = oom
+            events.append({
+                "event": (
+                    "growth_oom_risk" if oom else "growth_oom_risk_cleared"
+                ),
+                "phase": self.phase,
+            })
         if phase != self.phase:
             self.phase = phase
             events.append({"event": "phase", "phase": phase})
@@ -158,6 +203,13 @@ class HealthTracker:
         if self.stalled:
             self.stalled, self.stall_reason = False, None
             events.append({"event": "stall_cleared", "phase": self.phase})
+        if self.oom_risk:
+            # a finished run grew no further: the risk span closes with
+            # the run, like an open stall
+            self.oom_risk = False
+            events.append({
+                "event": "growth_oom_risk_cleared", "phase": self.phase,
+            })
         if self.phase != "done":
             self.phase = "done"
             events.append({"event": "phase", "phase": "done"})
@@ -206,6 +258,7 @@ class HealthTracker:
         return {
             "v": HEALTH_V,
             "phase": self.phase,
+            "oom_risk": self.oom_risk,
             "stalled": self.stalled,
             **(
                 {"stall_reason": self.stall_reason}

@@ -6,7 +6,8 @@ Record schema (every record):
    is how many old records the ring evicted)
  - ``t``    — seconds since the recorder was created (monotonic clock)
  - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
-   | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"note"``
+   | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"memory"``
+   | ``"note"``
 
 ``step`` records additionally carry the engine tag and cumulative counters
 (``states``, ``unique``) plus derived per-step deltas (``d_states``,
@@ -85,6 +86,10 @@ class FlightRecorder:
         # OUTSIDE the ring like the aggregate counters, so eviction never
         # loses it.  The engines refresh it per host sync.
         self._cartography: Optional[dict] = None
+        # latest HBM-ledger snapshot (telemetry/memory.py): same
+        # outside-the-ring discipline; setting it also arms the health
+        # model's growth_oom_risk forecast
+        self._memory: Optional[dict] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -191,6 +196,24 @@ class FlightRecorder:
         spawned without ``.telemetry(cartography=True)``."""
         with self._lock:
             return dict(self._cartography) if self._cartography else None
+
+    def set_memory(self, snap: dict) -> None:
+        """Replace the latest memory-ledger snapshot
+        (``telemetry/memory.py``) and feed its growth forecast to the
+        health model (the ``growth_oom_risk`` condition evaluates on the
+        next step record's table load)."""
+        with self._lock:
+            self._memory = dict(snap)
+            self._health.set_memory_forecast(
+                (snap.get("next_rung") or {}).get("transient_bytes"),
+                snap.get("budget_bytes"),
+            )
+
+    def memory(self) -> Optional[dict]:
+        """Latest memory-ledger snapshot, or None when the run was
+        spawned without ``.telemetry(memory=True)``."""
+        with self._lock:
+            return dict(self._memory) if self._memory else None
 
     def health(self) -> dict:
         """Live progress/health snapshot (health.py): phase, stall flag,
@@ -310,6 +333,7 @@ class FlightRecorder:
             cartography = (
                 dict(self._cartography) if self._cartography else None
             )
+            memory = dict(self._memory) if self._memory else None
         occ = [r for r in recs if r["kind"] == "occupancy"]
         out: dict = {
             **meta,
@@ -344,6 +368,8 @@ class FlightRecorder:
             out["stages"] = stages
         if cartography is not None:
             out["cartography"] = cartography
+        if memory is not None:
+            out["memory"] = memory
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")
@@ -371,6 +397,8 @@ class FlightRecorder:
                     )
             if summary.get("cartography") and self._cartography is None:
                 self._cartography = dict(summary["cartography"])
+            if summary.get("memory") and self._memory is None:
+                self._memory = dict(summary["memory"])
             if summary.get("states") is not None and self._last_step:
                 last_t = self._last_step[0]
                 self._last_step = (

@@ -87,6 +87,16 @@ def build_report(checker) -> dict:
         cart = checker.cartography()
     if cart is not None:
         out["cartography"] = cart
+    # memory ledger (telemetry/memory.py): the DETERMINISTIC analytic
+    # block only — per-buffer bytes at the final capacities + the next
+    # rung's growth-transient forecast.  Live device stats and the
+    # machine-local budget stay OUT of the JSON body (they vary by
+    # machine and moment; the markdown rendering carries them instead).
+    mem_fn = getattr(checker, "memory", None)
+    if callable(mem_fn):
+        mem = mem_fn(live=False)
+        if mem is not None:
+            out["memory"] = mem
     rec = getattr(checker, "flight_recorder", None)
     if rec is not None:
         growth = []
@@ -199,6 +209,38 @@ def render_markdown(report: dict, rec=None) -> str:
                 f"- all-to-all routed candidates: "
                 f"{cart['routed_candidates']}"
             )
+    mem = report.get("memory")
+    if mem:
+        from .memory import fmt_bytes
+
+        lines += ["", "## Memory (analytic)", ""]
+        lines.append(
+            f"- device-resident carry: **{fmt_bytes(mem.get('total_bytes'))}**"
+            f" at capacity {mem.get('capacity')}"
+            + (
+                f" over {mem['devices']} device(s) "
+                f"({fmt_bytes(mem.get('per_device_bytes'))}/device)"
+                if mem.get("devices")
+                else ""
+            )
+        )
+        nxt = mem.get("next_rung") or {}
+        if nxt:
+            lines.append(
+                f"- next growth rung (capacity {nxt.get('capacity')}): "
+                f"{fmt_bytes(nxt.get('total_bytes'))} steady, "
+                f"{fmt_bytes(nxt.get('transient_bytes'))} migration "
+                "transient (old + new carry live across the swap)"
+            )
+        buffers = mem.get("buffers") or {}
+        if buffers:
+            top = sorted(
+                buffers.items(), key=lambda kv: kv[1], reverse=True
+            )[:6]
+            lines.append(
+                "- largest buffers: "
+                + ", ".join(f"{k}={fmt_bytes(v)}" for k, v in top)
+            )
     timeline = report.get("health_timeline")
     if timeline:
         lines += ["", "## Health timeline (count-derived)", ""]
@@ -263,6 +305,23 @@ def render_markdown(report: dict, rec=None) -> str:
         if stages:
             for k, v in stages.items():
                 lines.append(f"- {k}: {v}")
+        live = rec.memory() if hasattr(rec, "memory") else None
+        if live and (live.get("device") or live.get("budget_bytes")):
+            from .memory import fmt_bytes
+
+            dev = live.get("device") or {}
+            bits = []
+            if dev.get("bytes_in_use") is not None:
+                bits.append(f"in use {fmt_bytes(dev['bytes_in_use'])}")
+            if dev.get("peak_bytes_in_use") is not None:
+                bits.append(f"peak {fmt_bytes(dev['peak_bytes_in_use'])}")
+            if live.get("budget_bytes"):
+                bits.append(
+                    f"budget {fmt_bytes(live['budget_bytes'])} "
+                    f"({live.get('budget_src')})"
+                )
+            if bits:
+                lines.append("- device memory: " + ", ".join(bits))
     lines.append("")
     return "\n".join(lines)
 

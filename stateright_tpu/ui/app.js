@@ -160,6 +160,65 @@ function renderCartography(cart) {
   $("cart-summary").textContent = bits.join("  ");
 }
 
+// ------------------------------------------------------------ memory --
+// Headroom panel over the /.metrics memory block (the HBM ledger,
+// telemetry/memory.py): analytic carry bytes vs the device budget, the
+// next growth rung's migration transient, and the live device readings
+// where the backend has them (absent on CPU — the panel then shows the
+// analytic numbers alone).
+const fmtBytes = (n) => {
+  if (n === null || n === undefined) return "-";
+  const units = ["B", "KB", "MB", "GB", "TB"];
+  let i = 0;
+  while (Math.abs(n) >= 1024 && i < units.length - 1) { n /= 1024; i++; }
+  return (i ? n.toFixed(1) : n.toFixed(0)) + units[i];
+};
+
+function renderMemory(mem, health) {
+  const panel = $("memory");
+  if (!mem) {
+    panel.hidden = true;
+    return;
+  }
+  panel.hidden = false;
+  const budget = mem.budget_bytes || null;
+  const live = mem.device || {};
+  const used = live.bytes_in_use !== undefined
+    ? live.bytes_in_use : mem.total_bytes;
+  const fill = $("mem-meter-fill");
+  if (budget) {
+    const frac = Math.min(used / budget, 1);
+    fill.style.width = (frac * 100).toFixed(1) + "%";
+    fill.className = "meter-fill" + (frac > 0.8 ? " meter-hot" : "");
+    $("mem-headroom").textContent =
+      "· " + fmtBytes(used) + " / " + fmtBytes(budget) +
+      " (" + (frac * 100).toFixed(1) + "%)";
+  } else {
+    fill.style.width = "0%";
+    $("mem-headroom").textContent =
+      "· " + fmtBytes(used) + " (no device limit known)";
+  }
+  const bits = ["carry=" + fmtBytes(mem.total_bytes)];
+  if (mem.per_device_bytes !== undefined)
+    bits.push("per-chip=" + fmtBytes(mem.per_device_bytes));
+  if (mem.next_rung)
+    bits.push(
+      "next rung transient=" + fmtBytes(mem.next_rung.transient_bytes)
+    );
+  if (live.peak_bytes_in_use !== undefined)
+    bits.push("peak=" + fmtBytes(live.peak_bytes_in_use));
+  $("mem-summary").textContent = bits.join("  ");
+  const risk = $("mem-risk");
+  if (health && health.oom_risk) {
+    risk.hidden = false;
+    risk.textContent =
+      "GROWTH OOM RISK: the next growth rung's transient does not fit " +
+      "this device — checkpoint or re-plan capacity";
+  } else {
+    risk.hidden = true;
+  }
+}
+
 function renderHealth(h) {
   const el = $("health-line");
   if (!h) {
@@ -208,6 +267,7 @@ async function pollMetrics() {
     $("tele-summary").textContent = bits.join("  ") || "—";
     renderHealth(m.health);
     renderCartography(m.cartography);
+    renderMemory(m.memory, m.health);
   } catch (e) {
     /* transient; retry next poll */
   }

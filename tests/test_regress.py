@@ -391,3 +391,90 @@ def test_cartography_section_gates_fresh_runs_only(tmp_path, capsys):
                                 "tpu_paxos3_cartography": cart}))
     rc, v = run(good, "--cartography")
     assert rc == 0 and v["cartography"]["baseline_present"] is True
+
+
+def test_memory_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--memory: a FRESH run must carry a well-formed HBM-ledger block
+    (versioned, buffers summing exactly to total_bytes, a growth
+    forecast whose transient covers old+new); stored baselines without
+    one (pre-memory rounds) never trip, staleness still exits 2 — the
+    --stages/--cartography rule applied to the memory artifact."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # note: baseline has no block
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    mem = {
+        "v": 1,
+        "engine": "wavefront",
+        "capacity": 131072,
+        "buffers": {"table_fp": 1048576, "table_parent": 1048576,
+                    "q_rows": 500000},
+        "total_bytes": 2597152,
+        "next_rung": {"capacity": 262144, "total_bytes": 4694304,
+                      "transient_bytes": 7291456},
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_paxos3_memory": mem}
+    # fresh + well-formed -> ok; absent baseline is informational
+    rc, v = run(good, "--memory")
+    assert rc == 0 and v["ok"] is True
+    assert v["memory"]["ok"] is True
+    assert v["memory"]["baseline_present"] is False
+    assert v["memory"]["summary"]["total_bytes"] == 2597152
+    # fresh but NO block -> exit 1, named in the verdict
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0},
+                "--memory")
+    assert rc == 1 and v["memory"]["ok"] is False
+    assert any("no tpu_paxos3_memory" in p for p in v["memory"]["problems"])
+    # malformed: buffers do not sum to total_bytes
+    rc, v = run({**good,
+                 "tpu_paxos3_memory": {**mem, "total_bytes": 999}},
+                "--memory")
+    assert rc == 1
+    assert any("sum(buffers)" in p for p in v["memory"]["problems"])
+    # malformed: MIXED-TYPE buffers map must yield a verdict, not a
+    # TypeError from the mismatch message (review find)
+    rc, v = run({**good,
+                 "tpu_paxos3_memory": {
+                     **mem, "buffers": {"a": 5, "b": "junk"},
+                 }}, "--memory")
+    assert rc == 1
+    assert any("non-int" in p for p in v["memory"]["problems"])
+    assert any("sum(buffers)" in p for p in v["memory"]["problems"])
+    # malformed: transient below the steady footprint (forecast must
+    # hold old + new carry live)
+    rc, v = run({**good,
+                 "tpu_paxos3_memory": {
+                     **mem,
+                     "next_rung": {"capacity": 262144,
+                                   "total_bytes": 4694304,
+                                   "transient_bytes": 100},
+                 }}, "--memory")
+    assert rc == 1
+    assert any("transient" in p for p in v["memory"]["problems"])
+    # unversioned -> exit 1
+    rc, v = run({**good,
+                 "tpu_paxos3_memory": {
+                     k: x for k, x in mem.items() if k != "v"
+                 }}, "--memory")
+    assert rc == 1
+    assert any("schema version" in p for p in v["memory"]["problems"])
+    # stale run: staleness exits 2 regardless of the memory gate
+    rc, v = run({"fresh": False}, "--memory")
+    assert rc == 2
+    # --allow-stale: a stored pre-memory artifact is reported, not gated
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0},
+                "--memory", "--allow-stale")
+    assert rc == 0 and v["memory"]["ok"] is False
+    # baseline WITH a block is noted for comparison
+    base.write_text(json.dumps({**BASELINE, "tpu_paxos3_memory": mem}))
+    rc, v = run(good, "--memory")
+    assert rc == 0 and v["memory"]["baseline_present"] is True

@@ -389,8 +389,8 @@ def test_explorer_metrics_endpoint_shape():
     try:
         m = _get(server.addr, "/.metrics")
         assert sorted(m) == [
-            "cartography", "counters", "health", "occupancy", "series",
-            "summary",
+            "cartography", "counters", "health", "memory", "occupancy",
+            "series", "summary",
         ]
         series = m["series"]
         assert sorted(series) == [
@@ -401,9 +401,11 @@ def test_explorer_metrics_endpoint_shape():
         assert all(len(series[k]) == n for k in series)
         assert m["summary"]["unique"] == 288
         assert m["occupancy"]["occupied"] == 288
-        # metrics-on, cartography-off: the block is an explicit null (the
-        # run was spawned without cartography=True), never fabricated
+        # metrics-on, cartography/memory-off: the blocks are explicit
+        # nulls (the run was spawned without cartography=True /
+        # memory=True), never fabricated
         assert m["cartography"] is None
+        assert m["memory"] is None
         # the health snapshot is always present with telemetry on
         assert m["health"]["phase"] == "done"
         assert m["health"]["stalled"] is False

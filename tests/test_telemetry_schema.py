@@ -60,7 +60,10 @@ SCHEMA = {
         {"rung": str, "source": str, "cache_hit": bool,
          "duration": _REAL},
         {"cap": int, "qcap": int, "batch": int, "cand": int, "fcap": int,
-         "bucket_cap": int, "prewarm_ready": bool, "build_secs": _REAL},
+         "bucket_cap": int, "prewarm_ready": bool, "build_secs": _REAL,
+         # memory ledger on: the executable's compile-time memory
+         # analysis (temp/argument/output bytes), backfilled via amend()
+         "memory": dict},
     ),
     "profile": (
         {"event": str},
@@ -77,6 +80,19 @@ SCHEMA = {
         },
         {"shard_load": list, "shard_imbalance": dict,
          "route_matrix": list, "routed_candidates": int},
+    ),
+    "memory": (
+        # the HBM ledger's per-rung snapshot (telemetry/memory.py):
+        # per-buffer analytic bytes + the growth-transient forecast;
+        # live device stats / budget / exec analysis appear only where
+        # the backend provides them
+        {
+            "v": int, "at": str, "engine": str, "capacity": int,
+            "buffers": dict, "total_bytes": int, "next_rung": dict,
+        },
+        {"queue_capacity": int, "frontier_capacity": int, "devices": int,
+         "per_device_bytes": int, "budget_bytes": int, "budget_src": str,
+         "exec": dict, "device": dict},
     ),
 }
 _ENVELOPE = {"seq": int, "t": _REAL, "kind": str}
@@ -143,19 +159,19 @@ def test_jsonl_header_is_versioned(tmp_path):
 
 def test_every_exported_record_matches_the_golden_schema(tmp_path):
     """One run exercising every record kind the wavefront engine can emit
-    (steps, growth, occupancy, compile, health, cartography), validated
-    field-by-field against the pinned schema."""
+    (steps, growth, occupancy, compile, health, cartography, memory),
+    validated field-by-field against the pinned schema."""
     lines = _export_lines(
         tmp_path,
         TwoPhaseSys(5).checker().telemetry(
-            occupancy_every=2, cartography=True
+            occupancy_every=2, cartography=True, memory=True
         ),
         capacity=1 << 10, batch=256,  # tiny: forces growth events
     )
     records = [ln for ln in lines if ln.get("kind") != "header"]
     kinds = {r["kind"] for r in records}
     for expect in ("step", "growth", "occupancy", "compile", "health",
-                   "cartography"):
+                   "cartography", "memory"):
         assert expect in kinds, f"run did not exercise {expect!r} records"
     problems = []
     for r in records:
@@ -184,3 +200,23 @@ def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
         sorted(p) == ["condition_hits", "evaluated", "name"]
         for p in props
     )
+
+
+def test_summary_memory_block_matches_snapshot_schema(tmp_path):
+    """The summary's embedded memory block is the ring records' shape
+    minus the envelope/at (the ``v`` field rides inside the snapshot):
+    consumers share one parser."""
+    lines = _export_lines(
+        tmp_path,
+        TwoPhaseSys(3).checker().telemetry(memory=True),
+        capacity=1 << 12, batch=64,
+    )
+    mem = lines[0]["summary"]["memory"]
+    required, optional = SCHEMA["memory"]
+    for k in required:
+        if k == "at":
+            continue  # summary holds the latest snapshot, not a series
+        assert k in mem, f"summary memory missing {k}"
+    for k in mem:
+        assert k in required or k in optional
+    assert mem["total_bytes"] == sum(mem["buffers"].values())
