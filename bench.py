@@ -43,6 +43,13 @@ device watermark) that explain them.  ``regress.py`` gates a fresh
 run's summary against BENCH_VALIDATED.json (``--cartography`` /
 ``--memory`` for the blocks' well-formedness).
 
+``BENCH_SPILL=1`` adds the flag-gated spill leg (docs/spill.md): the
+same 2pc-7 under a SIMULATED device budget smaller than its
+steady-state footprint (``tpu_2pc7_spill_*`` keys + the per-tier byte
+breakdown in ``tpu_2pc7_spill``); ``regress.py --spill`` gates its
+well-formedness and count parity.  ``BENCH_SPILL_BUDGET`` overrides
+the computed budget.
+
 ``value``/``vs_baseline`` are recomputed on every emit from whatever
 numbers exist so far.
 
@@ -830,6 +837,80 @@ def tpu_phase() -> dict:
     except Exception as e:  # noqa: BLE001
         out["tpu_2pc7_error"] = f"{type(e).__name__}: {e}"
     _persist(out)
+
+    # flag-gated SPILL leg (BENCH_SPILL=1; docs/spill.md): the same 2pc-7
+    # under a SIMULATED device budget provably smaller than the run's
+    # steady-state footprint — the ROADMAP's billion-state success
+    # metric.  Counts must be bit-identical to the unconstrained leg;
+    # the tpu_2pc7_spill block carries the per-tier byte breakdown.
+    if os.environ.get("BENCH_SPILL", "") == "1":
+        try:
+            _mark("2pc7 spill leg")
+            from stateright_tpu.parallel.tensor_model import twin_or_none
+            from stateright_tpu.telemetry.memory import (
+                ENV_DEVICE_BYTES,
+                total_bytes,
+                wavefront_specs,
+            )
+
+            t7s = TwoPhaseSys(7)
+            twin = twin_or_none(t7s)
+            n_props = len(list(t7s.properties()))
+            batch7, qcap7, bloom7 = 2048, 1 << 19, 1 << 23
+            sp_cfg = (bloom7, batch7 * twin.max_actions)
+
+            def _tot(cap):
+                return total_bytes(wavefront_specs(
+                    twin, n_props, cap, qcap7, batch7, cartography=True,
+                    spill=sp_cfg,
+                ))
+
+            # the unconstrained 2pc-7 run ends at a 1<<21 table; budget
+            # the 1<<20 -> 1<<21 migration transient OUT so the hot tier
+            # pins at 1<<20 (trigger 262,144 < the ~296k unique space)
+            # and at least one eviction must fire for the run to finish
+            budget = int(os.environ.get("BENCH_SPILL_BUDGET", 0)) or (
+                _tot(1 << 20) + _tot(1 << 21) - 1
+            )
+            out["tpu_2pc7_spill_budget_bytes"] = budget
+            prev = os.environ.get(ENV_DEVICE_BYTES)
+            os.environ[ENV_DEVICE_BYTES] = str(budget)
+            try:
+                spawn7s = lambda: (  # noqa: E731
+                    TwoPhaseSys(7).checker().spill()
+                    .telemetry(capacity=2048, cartography=True, memory=True)
+                    .spawn_tpu(
+                        sync=True, capacity=1 << 19, queue_capacity=qcap7,
+                        batch=batch7, steps_per_call=256, cand=1 << 15,
+                        spill_bloom_bits=bloom7,
+                    )
+                )
+                spawn7s()  # warm-up (same engine as the timed run)
+                tpu_sp, dt_sp = timed(spawn7s)
+            finally:
+                if prev is None:
+                    os.environ.pop(ENV_DEVICE_BYTES, None)
+                else:
+                    os.environ[ENV_DEVICE_BYTES] = prev
+            out["tpu_2pc7_spill_states_per_sec"] = round(
+                tpu_sp.state_count() / dt_sp, 1
+            )
+            out["tpu_2pc7_spill_unique"] = tpu_sp.unique_state_count()
+            out["tpu_2pc7_spill_states"] = tpu_sp.state_count()
+            out["tpu_2pc7_spill_sec"] = round(dt_sp, 3)
+            out["tpu_2pc7_spill"] = tpu_sp.spill_status()
+            if (
+                "tpu_2pc7_unique" in out
+                and tpu_sp.unique_state_count() != out["tpu_2pc7_unique"]
+            ):
+                out["tpu_2pc7_spill_note"] = (
+                    "MISMATCH vs the unconstrained run — investigate"
+                )
+            _mark("2pc7 spill leg done")
+        except Exception as e:  # noqa: BLE001 - the flag-gated leg must
+            # never void the primary metric
+            out["tpu_2pc7_spill_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
 
     # reference bench protocol on device.  All five configs compile — the
     # actor compiler gained ordered-FIFO network support in round 2

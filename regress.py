@@ -8,7 +8,7 @@ staleness and regressions LOUD:
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
-                      [--memory]
+                      [--memory] [--spill]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -293,6 +293,66 @@ def memory_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def spill_verdict(run: dict, baseline: dict) -> dict:
+    """``--spill``: the spill-tier section (docs/spill.md).
+
+    The spill leg is FLAG-gated (``BENCH_SPILL=1``), so absence never
+    trips — stale artifacts and pre-spill baselines pass untouched (the
+    POR-leg rule).  When the run carries one, it must be WELL-FORMED: a
+    versioned block with non-negative integer tier bytes and tallies,
+    at least one eviction (the leg's budget exists to force one), and —
+    when the unconstrained leg also ran — bit-identical unique counts
+    (the tier's core contract).  A crashed leg
+    (``tpu_2pc7_spill_error``) is a gate failure, not a skip."""
+    out: dict = {}
+    leg_error = run.get("tpu_2pc7_spill_error")
+    if leg_error:
+        out["present"] = False
+        out["ok"] = False
+        out["problems"] = [f"leg crashed: {leg_error}"]
+        return out
+    leg = run.get("tpu_2pc7_spill")
+    out["present"] = bool(leg)
+    if leg is None:
+        out["ok"] = True  # flag-gated: absence is not a failure
+        out["baseline_present"] = bool(baseline.get("tpu_2pc7_spill"))
+        return out
+    problems = []
+    if not isinstance(leg, dict) or not isinstance(leg.get("v"), int):
+        problems.append("tpu_2pc7_spill block malformed (missing v)")
+    else:
+        for k in ("evictions", "spilled_fps", "host_bytes", "disk_bytes",
+                  "resolved_dups", "resolved_novel"):
+            v = leg.get(k)
+            if not isinstance(v, int) or v < 0:
+                problems.append(f"tpu_2pc7_spill.{k} missing/negative")
+        if isinstance(leg.get("evictions"), int) and leg["evictions"] < 1:
+            problems.append(
+                "spill leg ran without a single eviction — the simulated "
+                "budget did not constrain the run"
+            )
+    u_sp = run.get("tpu_2pc7_spill_unique")
+    u_full = run.get("tpu_2pc7_unique")
+    if isinstance(u_sp, int) and isinstance(u_full, int) and u_sp != u_full:
+        problems.append(
+            f"spill unique {u_sp} != unconstrained unique {u_full} "
+            "(the tier must not change counts)"
+        )
+    out["ok"] = not problems
+    if problems:
+        out["problems"] = problems
+    out["summary"] = {
+        "evictions": leg.get("evictions") if isinstance(leg, dict) else None,
+        "spilled_fps": (
+            leg.get("spilled_fps") if isinstance(leg, dict) else None
+        ),
+        "host_bytes": leg.get("host_bytes") if isinstance(leg, dict) else None,
+        "disk_bytes": leg.get("disk_bytes") if isinstance(leg, dict) else None,
+    }
+    out["baseline_present"] = bool(baseline.get("tpu_2pc7_spill"))
+    return out
+
+
 def stage_verdict(run: dict, baseline: dict) -> dict:
     """``--stages``: the per-stage attribution section (docs/perf.md).
 
@@ -326,7 +386,7 @@ def main(argv=None, fleet=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
-    stages = cartography = independence = memory = False
+    stages = cartography = independence = memory = spill = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -345,6 +405,8 @@ def main(argv=None, fleet=None) -> int:
             independence = True
         elif a == "--memory":
             memory = True
+        elif a == "--spill":
+            spill = True
         else:
             pos.append(a)
     if pos:
@@ -394,6 +456,12 @@ def main(argv=None, fleet=None) -> int:
         # baselines never trip
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["memory"]["ok"]
+    if spill:
+        verdict["spill"] = spill_verdict(run, baseline)
+        # flag-gated leg: absence passes; a present-but-malformed (or
+        # crashed, or count-drifting) leg trips fresh runs only
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["spill"]["ok"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -455,6 +523,17 @@ def main(argv=None, fleet=None) -> int:
             "block (tpu_paxos3_memory) — a perf number without its HBM "
             "footprint cannot drive the capacity tier "
             "(docs/telemetry.md)\n"
+        )
+        return 1
+    if (
+        "spill" in verdict
+        and verdict["fresh"]
+        and not verdict["spill"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the spill leg is malformed, crashed, or drifted "
+            "its counts (tpu_2pc7_spill; see stdout JSON) — a spill tier "
+            "that changes counts is not a capacity tier (docs/spill.md)\n"
         )
         return 1
     return 0

@@ -43,6 +43,8 @@ class CheckerBuilder:
         self.compile_cache_dir: Optional[str] = None
         # partial-order reduction (docs/analysis.md); None = env default
         self.por_mode: Optional[bool] = None
+        # billion-state spill tier (docs/spill.md); None = env default
+        self.spill_mode: Optional[bool] = None
 
     # -- configuration -------------------------------------------------------
 
@@ -285,6 +287,36 @@ class CheckerBuilder:
         ``STATERIGHT_TPU_POR=1``.  Composes with ``symmetry()`` and
         ``prededup()``."""
         self.por_mode = bool(enabled)
+        return self
+
+    def spill(self, enabled: bool = True) -> "CheckerBuilder":
+        """Arm the billion-state spill tier on the wavefront engine
+        (``stateright_tpu/spill/``; docs/spill.md): the visited set
+        becomes a TIERED store — the HBM bucket table as the hot tier,
+        backed by a host-RAM append-only fingerprint store (hash-indexed)
+        with an mmap'd disk tier behind it.  When PR 7's capacity plan
+        says the next growth rung's migration transient will not fit the
+        device budget (live ``bytes_limit`` or the
+        ``STATERIGHT_TPU_DEVICE_BYTES`` override), the engine EVICTS the
+        hot table's contents to the host tier at the growth boundary
+        instead of growing; a device-side Bloom filter over the spilled
+        set (bit-slices of ``mix64(fp)``) answers "definitely not seen"
+        on-device, so only Bloom-positive candidates are resolved against
+        the host index at host sync.
+
+        Contracts, pinned by tests/test_spill.py: spill OFF (the
+        default) leaves the step jaxpr bit-identical and the engine
+        cache unkeyed; spill ON keeps unique/total counts and property
+        verdicts bit-identical to an unconstrained run, with the
+        cartography block reconciling exactly.  The snapshot manifest
+        carries the host/disk tier contents, so kill+resume works
+        mid-spill.  Env override ``STATERIGHT_TPU_SPILL=1``; wavefront
+        engine only (the sharded engine rejects it with guidance), and
+        mutually exclusive with ``por()`` for now.  Spawn knobs:
+        ``spill_bloom_bits``, ``spill_dir``, ``spill_host_bytes``
+        (host-tier budget before the disk tier takes over; env
+        ``STATERIGHT_TPU_HOST_BYTES``)."""
+        self.spill_mode = bool(enabled)
         return self
 
     def checked(self, enabled: bool = True) -> "CheckerBuilder":

@@ -218,6 +218,10 @@ def _metrics_view(checker) -> Optional[dict]:
         # spawned with .telemetry(memory=True).  The UI's headroom panel
         # reads it.
         "memory": rec.memory(),
+        # spill-tier block (stateright_tpu/spill/, docs/spill.md):
+        # per-tier bytes, Bloom load, deferral tallies; null unless the
+        # run was spawned with .spill()
+        "spill": rec.spill(),
     }
 
 

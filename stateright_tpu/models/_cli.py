@@ -79,8 +79,8 @@ def run_cli(
         print(usage)
         if check_tpu is not None:
             print("  device verbs also take --checked, --prewarm, "
-                  "--prededup, --por, --compile-cache=DIR "
-                  "(docs/perf.md, docs/analysis.md) and "
+                  "--prededup, --por, --spill, --compile-cache=DIR "
+                  "(docs/perf.md, docs/analysis.md, docs/spill.md) and "
                   "--watch (live status line, docs/telemetry.md)")
         if audit is not None:
             print("  <example> audit    # static preflight audit "
@@ -121,7 +121,7 @@ def pop_perf(rest: list) -> tuple:
     work without the flags — these exist so one-off CLI runs can A/B."""
     rest = list(rest)
     cfg = {"prewarm": False, "prededup": False, "compile_cache": None,
-           "por": False}
+           "por": False, "spill": False}
     kept = []
     for a in rest:
         if a == "--prewarm":
@@ -130,6 +130,8 @@ def pop_perf(rest: list) -> tuple:
             cfg["prededup"] = True
         elif a == "--por":
             cfg["por"] = True
+        elif a == "--spill":
+            cfg["spill"] = True
         elif a.startswith("--compile-cache="):
             cfg["compile_cache"] = a[len("--compile-cache="):]
         else:
@@ -145,6 +147,8 @@ def apply_perf(builder, cfg: dict):
         builder = builder.prededup()
     if cfg.get("por"):
         builder = builder.por()
+    if cfg.get("spill"):
+        builder = builder.spill()
     if cfg.get("compile_cache"):
         builder = builder.compile_cache(cfg["compile_cache"])
     return builder
@@ -192,10 +196,15 @@ def watch_line(checker) -> str:
         f"hbm={_watch_hbm(rec)}",
         f"phase={h.get('phase', '-')}",
     ]
+    sp = _watch_spill(rec)
+    if sp:
+        parts.append(f"spill={sp}")
     if h.get("stalled"):
         parts.append(f"STALLED({h.get('stall_reason') or '?'})")
     if h.get("oom_risk"):
         parts.append("OOM-RISK(next growth rung does not fit)")
+    if h.get("spill_forecast"):
+        parts.append("spill-forecast(next rung evicts to host)")
     if h.get("eta_secs") is not None:
         parts.append(f"eta={h['eta_secs']}s")
     return " ".join(parts)
@@ -219,6 +228,22 @@ def _watch_hbm(rec) -> str:
             f"({100.0 * used / budget:.1f}%)"
         )
     return fmt_bytes(used)
+
+
+def _watch_spill(rec) -> str:
+    """The ``spill=`` column: spilled-state count + per-tier bytes once
+    the tier has evicted anything; '' when the tier is off or idle."""
+    sp = rec.spill() if rec is not None else None
+    if not sp or not sp.get("spilled_fps"):
+        return ""
+    from ..telemetry.memory import fmt_bytes
+
+    out = (
+        f"{sp['spilled_fps']}fp/host:{fmt_bytes(sp.get('host_bytes'))}"
+    )
+    if sp.get("disk_bytes"):
+        out += f"/disk:{fmt_bytes(sp['disk_bytes'])}"
+    return out
 
 
 def watch_checker(
@@ -505,17 +530,22 @@ def fleet_independence(names: Optional[list] = None, stream=None) -> int:
 # -- capacity verb -----------------------------------------------------------
 
 
-def capacity_and_report(models: Iterable[tuple], stream=None) -> bool:
+def capacity_and_report(
+    models: Iterable[tuple], stream=None, spill: bool = False
+) -> bool:
     """HBM capacity plan over ``(label, model)`` pairs
     (``telemetry/memory.py``; docs/telemetry.md "Memory ledger"): the
     analytic per-rung footprint ladder of the wavefront engine at its
     default spawn capacities, the growth-migration transient per rung,
     and — when a device budget is known (live ``memory_stats`` or the
     ``STATERIGHT_TPU_DEVICE_BYTES`` override) — the max reachable unique
-    count before the run would spill.  Pure host arithmetic: no device
-    run, no compile; on CPU (no budget) it degrades to the analytic
-    table alone, never crashes.  Returns True iff every configuration
-    produced a plan (twin-less models are reported and skipped)."""
+    count before the run would spill.  ``spill=True`` (the ``--spill``
+    flag) plans WITH the spill tier armed: ``max_unique`` extends past
+    the largest-fitting rung by the host tier's reach (docs/spill.md)
+    instead of capping at HBM/4.  Pure host arithmetic: no device run,
+    no compile; on CPU (no budget) it degrades to the analytic table
+    alone, never crashes.  Returns True iff every configuration produced
+    a plan (twin-less models are reported and skipped)."""
     from ..parallel.tensor_model import twin_or_none
     from ..telemetry.memory import (
         capacity_plan,
@@ -555,6 +585,7 @@ def capacity_and_report(models: Iterable[tuple], stream=None) -> bool:
             plan = capacity_plan(
                 spec_fn, caps, budget=budget,
                 rungs=24 if budget is not None else 10,
+                spill=spill,
             )
         except Exception as e:  # noqa: BLE001 - a plan failure is a
             # verdict, not a crash (the CI smoke's contract)
@@ -585,12 +616,24 @@ def capacity_and_report(models: Iterable[tuple], stream=None) -> bool:
                 f"{'-' if fits is None else ('yes' if fits else 'NO')}",
                 file=stream,
             )
-        if plan.get("max_unique") is not None:
+        sp = plan.get("spill")
+        if sp is not None:
+            print(
+                f"with --spill, {label} reaches "
+                f"~{sp['hot_max_unique']:,} unique states on-device, then "
+                f"~{sp.get('host_max_unique', 0):,} more in the host tier "
+                f"({fmt_bytes(sp.get('host_budget_bytes'))} at "
+                f"{sp['bytes_per_spilled']}B/state), disk tier unbounded "
+                f"behind it — max_unique ~{plan['max_unique']:,} "
+                "(docs/spill.md)",
+                file=stream,
+            )
+        elif plan.get("max_unique") is not None:
             print(
                 f"on this device, {label} reaches ~{plan['max_unique']:,} "
                 "unique states before spilling (largest rung whose "
-                "growth transient fits; spill tier: ROADMAP "
-                "billion-state item)",
+                "growth transient fits; extend past it with --spill / "
+                "CheckerBuilder.spill(), docs/spill.md)",
                 file=stream,
             )
         elif budget is not None:
@@ -602,12 +645,23 @@ def capacity_and_report(models: Iterable[tuple], stream=None) -> bool:
     return ok
 
 
+def pop_spill(rest: list) -> tuple:
+    """Strip ``--spill`` from a verb's arguments: ``(spill, rest)``."""
+    rest = list(rest)
+    spill = "--spill" in rest
+    while "--spill" in rest:
+        rest.remove("--spill")
+    return spill, rest
+
+
 def make_capacity_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
     """Wrap a ``rest -> [(label, model), ...]`` factory as a ``capacity``
-    CLI verb (exit 1 only when the plan itself crashes)."""
+    CLI verb (exit 1 only when the plan itself crashes).  ``--spill``
+    plans with the spill tier armed (docs/spill.md)."""
 
     def _capacity(rest: list) -> None:
-        if not capacity_and_report(factory(rest)):
+        spill, rest = pop_spill(rest)
+        if not capacity_and_report(factory(rest), spill=spill):
             raise SystemExit(1)
 
     return _capacity
@@ -622,6 +676,7 @@ def fleet_capacity(names: Optional[list] = None, stream=None) -> int:
     from . import __all__ as all_names
 
     stream = stream or sys.stdout
+    spill, names = pop_spill(list(names or []))
     ok = True
     for name in names or list(all_names):
         mod = importlib.import_module(f"stateright_tpu.models.{name}")
@@ -634,7 +689,7 @@ def fleet_capacity(names: Optional[list] = None, stream=None) -> int:
             )
             ok = False
             continue
-        ok = capacity_and_report(factory([]), stream=stream) and ok
+        ok = capacity_and_report(factory([]), stream=stream, spill=spill) and ok
     print("capacity fleet: " + ("OK" if ok else "FAILED"), file=stream)
     return 0 if ok else 1
 

@@ -102,6 +102,26 @@ def window_unique(fps: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(keep, fps, EMPTY)
 
 
+def lane_compact(mask: jnp.ndarray, width: int):
+    """Order-preserving lane compaction: ``(idx, live, count)`` such
+    that ``x[idx]`` gathers the first ``width`` True lanes of ``mask``
+    to the front (``live`` flags which output lanes are real, ``count``
+    the total True lanes).  The cumsum + vectorized-searchsorted idiom
+    ``bucket_insert``'s candidate-budget compaction uses — kept INLINE
+    there (byte-identical jaxprs keep the persistent compile cache warm
+    across releases); new call sites (the spill tier's pending-deferral
+    append) use this helper instead of a third copy."""
+    m = mask.shape[0]
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    count = csum[m - 1]
+    idx = jnp.searchsorted(
+        csum, jnp.arange(1, width + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    idx = jnp.minimum(idx, jnp.int32(m - 1))
+    live = jnp.arange(width, dtype=jnp.int32) < count
+    return idx, live, count
+
+
 def bucket_of(fps, nbuckets: int) -> np.ndarray:
     """Host-side bucket derivation (numpy): the bucket ``bucket_insert``
     and ``host_bucket_rehash`` place ``fps`` in for an ``nbuckets``-bucket

@@ -98,6 +98,7 @@ class WavefrontChecker(Checker):
             ENV_POR,
             ENV_PREDEDUP,
             ENV_PREWARM,
+            ENV_SPILL,
             enable_persistent_compile_cache,
             resolve_flag,
         )
@@ -140,6 +141,32 @@ class WavefrontChecker(Checker):
                         "(docs/analysis.md)",
                         file=sys.stderr,
                     )
+        # billion-state spill tier (stateright_tpu/spill/, docs/spill.md):
+        # host-backed visited overflow with a device-side Bloom
+        # pre-filter.  Wavefront engine only (the sharded engine's table
+        # is mesh-distributed — spilling it is the pod-scale round's
+        # work), and mutually exclusive with POR for now (the two-phase
+        # ample insert and the Bloom deferral do not compose).
+        self._spill = resolve_flag(
+            getattr(options, "spill_mode", None), ENV_SPILL
+        )
+        if self._spill:
+            if self._engine_tag != "single":
+                raise NotImplementedError(
+                    "spill mode (CheckerBuilder.spill()) is single-device "
+                    "only for now: the sharded engine's visited table is "
+                    "mesh-distributed and spills with the pod-scale mesh "
+                    "round (ROADMAP).  Drop the devices/mesh argument, or "
+                    "drop .spill()/--spill/STATERIGHT_TPU_SPILL."
+                )
+            if self._por:
+                raise NotImplementedError(
+                    "spill mode does not compose with partial-order "
+                    "reduction yet (the POR two-phase insert and the "
+                    "Bloom deferral conflict; docs/spill.md).  Drop one "
+                    "of .spill()/.por()."
+                )
+            self._init_spill()
         self._prewarm = resolve_flag(
             getattr(options, "prewarm_mode", None), ENV_PREWARM
         )
@@ -173,6 +200,11 @@ class WavefrontChecker(Checker):
         self._report_written = False
         tag = "wavefront" if self._engine_tag == "single" else self._engine_tag
         self.flight_recorder = options._make_recorder(tag)
+        if self._spill and self.flight_recorder is not None:
+            # spill armed: the health model downgrades growth_oom_risk to
+            # the informational spill forecast — the run will not OOM at
+            # the wall, it will evict (telemetry/health.py)
+            self.flight_recorder.set_spill_armed(True)
         # HBM memory ledger (telemetry/memory.py): per-buffer analytic
         # accounting + growth-transient forecast + live device readings.
         # Pure host arithmetic over shapes the engines already know —
@@ -348,6 +380,20 @@ class WavefrontChecker(Checker):
             raise ValueError(
                 "resume snapshot was taken from a different model "
                 "(init fingerprints / tensor signature disagree)"
+            )
+        if not getattr(self, "_spill", False) and (
+            int(snap.get("spill_base", 0) or 0) > 0
+            or "spill_fp" in snap
+            or "spill_q_fp" in snap
+            or "spill_pend_fp" in snap
+        ):
+            # part of the visited set lives in the snapshot's host-tier
+            # manifest: resuming without the tier would silently re-count
+            # every spilled state as fresh
+            raise ValueError(
+                "resume snapshot carries spill-tier contents (host/disk "
+                "visited overflow); resume with CheckerBuilder.spill() / "
+                "--spill / STATERIGHT_TPU_SPILL=1 (docs/spill.md)"
             )
         # snapshot-manifest capacity check (telemetry/memory.py): the
         # snapshot records its analytic footprint (older ones fall back

@@ -143,6 +143,7 @@ ENV_COMPILE_CACHE = "STATERIGHT_TPU_COMPILE_CACHE"
 ENV_PREWARM = "STATERIGHT_TPU_PREWARM"
 ENV_PREDEDUP = "STATERIGHT_TPU_PREDEDUP"
 ENV_POR = "STATERIGHT_TPU_POR"
+ENV_SPILL = "STATERIGHT_TPU_SPILL"
 
 _cache_lock = threading.Lock()
 _cache_dir: Optional[str] = None

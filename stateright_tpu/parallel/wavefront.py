@@ -66,7 +66,13 @@ import jax.numpy as jnp
 
 from ..checker.base import CheckerBuilder
 from ..core import Expectation
-from ..ops.buckets import SLOTS, bucket_insert, host_bucket_rehash, window_unique
+from ..ops.buckets import (
+    SLOTS,
+    bucket_insert,
+    host_bucket_rehash,
+    lane_compact,
+    window_unique,
+)
 from ..ops.hashing import EMPTY, row_hash
 from ._base import WavefrontChecker
 from .prewarm import CompileWatch, donation_supported
@@ -76,6 +82,8 @@ _STATUS_QUEUE_FULL = 1
 _STATUS_TABLE_FULL = 2
 _STATUS_CAND_FULL = 3  # valid candidates exceeded the compaction budget
 _STATUS_POISON = 4  # a compiled-twin transition crossed its compile bound
+_STATUS_SPILL_SYNC = 5  # spill tier: pending buffer near-full, the host
+#                         must resolve it against the host index
 
 # growth-record names for the flight recorder, keyed on THIS engine's
 # status words (telemetry.STATUS_NAMES is the cross-engine vocabulary;
@@ -86,6 +94,7 @@ _STATUS_TELEMETRY_NAMES = {
     _STATUS_TABLE_FULL: "table_full",
     _STATUS_CAND_FULL: "cand_full",
     _STATUS_POISON: "poison",
+    _STATUS_SPILL_SYNC: "spill_sync",
 }
 
 # Carry tuple indices (shared by the jitted program and the host loop).
@@ -105,6 +114,22 @@ _ERR = 13
 # error flag; snapshots drop them too (per-step tallies restart at a
 # resume boundary, like the error flag re-seed)
 
+# spill mode only (stateright_tpu/spill/, docs/spill.md): the spill tail
+# rides the carry AFTER the POR pair and BEFORE the cartography counters:
+# the device Bloom filter over the spilled fingerprint set (read-only on
+# device; the host sets bits at eviction boundaries), the spill base
+# (how many unique states live off-device — the growth trigger reads hot
+# occupancy as ``unique - spill_base``), the pending buffers holding
+# Bloom-positive candidates deferred to host resolution, the pending
+# count, and the deferred/on-device tally pair.  Offsets below are
+# relative to the engine's ``spill_start``.
+_SPILL_LEN = 9
+(_SP_BLOOM, _SP_BASE, _SP_PFP, _SP_PROWS, _SP_PPAR, _SP_PEBT, _SP_PDEP,
+ _SP_PCOUNT, _SP_STATS) = range(_SPILL_LEN)
+# packed stats-vector section when spill is on: [pend_count, spill_base,
+# deferred_total, on_device_total]
+_SPILL_STATS_SECTION = 4
+
 _SNAPSHOT_KEYS = (
     "table_fp", "table_parent", "q_rows", "q_fp", "q_ebits",
     "q_depth", "head", "tail", "unique", "scount", "disc", "maxdepth",
@@ -119,17 +144,24 @@ _STATS_CARRY_ORDER = (_HEAD, _TAIL, _UNIQUE, _SCOUNT, _MAXDEPTH, _STATUS)
 
 
 def _stats_np(carry, cart_start: Optional[int] = None,
-              por_start: Optional[int] = None) -> np.ndarray:
+              por_start: Optional[int] = None,
+              spill_start: Optional[int] = None) -> np.ndarray:
     """Host-side equivalent of the jitted ``stats_of`` (same layout).
     ``por_start`` appends the POR stats triple (carry[por_start + 1]);
-    ``cart_start`` appends the cartography section: the queue-derived
-    depth histogram first, then the counter buffers (carry tail from that
-    index on), exactly as the device ``stats_of`` does."""
+    ``spill_start`` appends the spill section (pend count, spill base,
+    deferred/on-device tallies); ``cart_start`` appends the cartography
+    section: the queue-derived depth histogram first, then the counter
+    buffers (carry tail from that index on), exactly as the device
+    ``stats_of`` does."""
     vals = [np.asarray(carry[i]) for i in _STATS_CARRY_ORDER] + list(
         np.asarray(carry[_DISC])
     )
     if por_start is not None:
         vals.extend(np.asarray(carry[por_start + 1]).reshape(-1))
+    if spill_start is not None:
+        vals.append(np.asarray(carry[spill_start + _SP_PCOUNT]))
+        vals.append(np.asarray(carry[spill_start + _SP_BASE]))
+        vals.extend(np.asarray(carry[spill_start + _SP_STATS]).reshape(-1))
     if cart_start is not None:
         from ..ops.cartography import queue_depth_hist_np
 
@@ -147,7 +179,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
                   sym: bool = False, cand: Optional[int] = None,
                   checked: bool = False, prededup: bool = False,
-                  cartography: bool = False, por=None):
+                  cartography: bool = False, por=None, spill=None):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -201,8 +233,15 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     # POR's cycle proviso appends a SECOND novel window per step (at
     # tail + n_new): over-allocate one more window so both appends stay
     # in bounds without clamping — a clamped dynamic_update_slice would
-    # silently shift the write onto live queue rows
-    qalloc = qcap + (2 * m if por is not None else m)
+    # silently shift the write onto live queue rows.  The spill inject
+    # program appends a pend_cap-wide window the same way, so its
+    # (larger) width governs the slack when the tier is armed.
+    if por is not None:
+        qalloc = qcap + 2 * m
+    elif spill is not None:
+        qalloc = qcap + max(spill[1], m)
+    else:
+        qalloc = qcap + m
     n_props = len(props)
     ev_idx = [
         i for i, p in enumerate(props) if p.expectation is Expectation.EVENTUALLY
@@ -226,10 +265,21 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         checked_kernels = checkify_kernels(tensor)
 
     # carry tail layout: [base 13] + [err]? + [por boost, por stats]? +
-    # [cartography buffers]?  (snapshots keep only the base; every tail
-    # element re-seeds at resume)
+    # [spill tail]? + [cartography buffers]?  (snapshots keep only the
+    # base; every tail element re-seeds at resume — the spill tail from
+    # the snapshot's host-tier manifest)
     por_start = (_ERR + 1) if checked else _ERR
-    cart_start = por_start + (2 if por is not None else 0)
+    spill_start = por_start + (2 if por is not None else 0)
+    cart_start = spill_start + (_SPILL_LEN if spill is not None else 0)
+    if spill is not None:
+        # spill tier (stateright_tpu/spill/, docs/spill.md): POR's
+        # two-phase insert and the Bloom deferral do not compose yet —
+        # the builder rejects the combination before the engine is built
+        assert por is None, "spill and por are mutually exclusive"
+        from ..spill.bloom import bloom_test
+
+        spill_bits, pend_cap = spill
+        palloc = pend_cap + m
     if por is not None:
         from ..analysis.footprint import conjunct_eval_fn
         from ..ops.por import ample_mask, candidate_novelty
@@ -359,6 +409,20 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             # pipeline run at the window's UNIQUE count.  scount deliberately
             # still sums the generated states, duplicates included.
             cand_fp = window_unique(cand_fp)
+        if spill is not None:
+            # Bloom pre-filter (spill/bloom.py): a candidate the filter
+            # says MAY be spilled leaves the on-device insert entirely —
+            # it is appended to the pending buffer below and resolved
+            # against the host index at the next host sync.  A Bloom MISS
+            # is a proof of off-device absence (no false negatives), so
+            # the common case never leaves the chip; before the first
+            # eviction the filter is all-zero and nothing defers.
+            sp_bloom = carry[spill_start + _SP_BLOOM]
+            fp_full = cand_fp
+            maybe_spilled = (cand_fp != EMPTY) & bloom_test(
+                sp_bloom, cand_fp, spill_bits
+            )
+            cand_fp = jnp.where(maybe_spilled, EMPTY, cand_fp)
         cand_rows = succ.reshape(m, width)
         cand_par = jnp.broadcast_to(fps[:, None], (batch, arity)).reshape(-1)
         cand_ebt = jnp.broadcast_to(ebits[:, None], (batch, arity)).reshape(-1)
@@ -425,6 +489,39 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         # with POR's two inserts the table itself rolls back so the replay
         # sees the same novelty verdicts.)
         overflow = toverflow | coverflow
+        if spill is not None:
+            # append the deferred lanes (compacted, order-preserving: the
+            # cumsum/searchsorted idiom bucket_insert's budget compaction
+            # uses) at the pending cursor.  The buffer writes run even on
+            # an overflowed batch — the cursor then does not advance, so
+            # the post-growth replay overwrites the same window (the
+            # counters' replay discipline).
+            pcount = carry[spill_start + _SP_PCOUNT]
+            sp_stats = carry[spill_start + _SP_STATS]
+            didx, dlive, n_def = lane_compact(maybe_spilled, m)
+            pfp_b = jax.lax.dynamic_update_slice(
+                carry[spill_start + _SP_PFP],
+                jnp.where(dlive, fp_full[didx], EMPTY), (pcount,),
+            )
+            prows_b = jax.lax.dynamic_update_slice(
+                carry[spill_start + _SP_PROWS], cand_rows[didx],
+                (pcount, jnp.int32(0)),
+            )
+            ppar_b = jax.lax.dynamic_update_slice(
+                carry[spill_start + _SP_PPAR], cand_par[didx], (pcount,)
+            )
+            pebt_b = jax.lax.dynamic_update_slice(
+                carry[spill_start + _SP_PEBT], cand_ebt[didx], (pcount,)
+            )
+            pdep_b = jax.lax.dynamic_update_slice(
+                carry[spill_start + _SP_PDEP], cand_dep[didx], (pcount,)
+            )
+            pcount = pcount + jnp.where(overflow, jnp.int32(0), n_def)
+            d_sp = jnp.stack([
+                n_def.astype(jnp.int64),
+                jnp.sum(valid, dtype=jnp.int64) - n_def.astype(jnp.int64),
+            ])
+            sp_stats = sp_stats + jnp.where(overflow, jnp.int64(0), d_sp)
         if por is not None:
             tfp = jnp.where(overflow, tfp_pre, tfp)
             tpl = jnp.where(overflow, tpl_pre, tpl)
@@ -469,9 +566,15 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             cart = (act_hist, p_evals, p_hits)
         # Clean-boundary growth triggers: past these thresholds the host
         # grows buffers and resumes (table target load ≤ 25%: the Poisson
-        # bucket-overflow tail stays negligible).
+        # bucket-overflow tail stays negligible).  With the spill tier
+        # armed the trigger reads HOT occupancy — evicted uniques live
+        # off-device and must not count against the hot table's load.
+        if spill is not None:
+            hot_unique = unique - carry[spill_start + _SP_BASE]
+        else:
+            hot_unique = unique
         status = jnp.where(
-            toverflow | (unique * 4 > cap) | (eff_cand * 4 > cap),
+            toverflow | (hot_unique * 4 > cap) | (eff_cand * 4 > cap),
             jnp.int32(_STATUS_TABLE_FULL),
             jnp.where(
                 coverflow,
@@ -479,6 +582,16 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                 jnp.where(tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status),
             ),
         )
+        if spill is not None:
+            # the pending buffer cannot take another full window: stop the
+            # block at this clean boundary so the host resolves it.  Lowest
+            # priority — a growth status wins (growth also syncs).
+            status = jnp.where(
+                (status == jnp.int32(_STATUS_OK))
+                & (pcount + m > jnp.int32(pend_cap)),
+                jnp.int32(_STATUS_SPILL_SYNC),
+                status,
+            )
         if poison_fn is not None:
             # a poisoned popped row means a compile-time bound was crossed
             # by a REACHABLE transition — silently wrong counts otherwise;
@@ -495,6 +608,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             out = out + (err,)
         if por is not None:
             out = out + (boost, pstats)
+        if spill is not None:
+            out = out + (
+                sp_bloom, carry[spill_start + _SP_BASE], pfp_b, prows_b,
+                ppar_b, pebt_b, pdep_b, pcount, sp_stats,
+            )
         return out + tuple(cart)
 
     def cond(state):
@@ -522,6 +640,15 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             # the reduced-vs-full tallies ride the same packed vector,
             # right after the discovery fps (before any cartography)
             parts.append(carry[por_start + 1].astype(jnp.uint64))
+        if spill is not None:
+            # spill section: pending count (the host's resolve trigger),
+            # the spill base, and the deferred/on-device tally pair —
+            # all on the SAME packed vector, no extra round-trip
+            parts.append(jnp.stack([
+                carry[spill_start + _SP_PCOUNT].astype(jnp.uint64),
+                carry[spill_start + _SP_BASE].astype(jnp.uint64),
+            ]))
+            parts.append(carry[spill_start + _SP_STATS].astype(jnp.uint64))
         if cartography:
             # the counters ride the SAME packed vector: cartography never
             # adds a second host round-trip per sync.  The depth histogram
@@ -599,6 +726,20 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         if por is not None:
             # boost=0: the init batch is not a growth/resume boundary
             carry = carry + (jnp.int32(0), jnp.zeros((3,), jnp.int64))
+        if spill is not None:
+            # all-zero Bloom (nothing spilled yet -> nothing ever defers),
+            # empty pending buffers, spill base 0
+            carry = carry + (
+                jnp.zeros((spill_bits // 32,), jnp.uint32),
+                jnp.int64(0),
+                jnp.full((palloc,), EMPTY, jnp.uint64),
+                jnp.zeros((palloc, width), jnp.uint64),
+                jnp.zeros((palloc,), jnp.uint64),
+                jnp.zeros((palloc,), jnp.uint32),
+                jnp.zeros((palloc,), jnp.uint32),
+                jnp.int32(0),
+                jnp.zeros((2,), jnp.int64),
+            )
         if cartography:
             # per-step tallies start at zero; the depth histogram is not
             # carried — the init states' depth-0 lanes already sit in
@@ -627,7 +768,7 @@ def _repad_queue(carry_np: list, qalloc: int) -> None:
 
 def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
                  checked: bool, cartography: bool = False,
-                 por: bool = False) -> tuple:
+                 por: bool = False, spill=None) -> tuple:
     """Abstract carry signature of the engine built for these capacities —
     what ahead-of-time compilation (``run_fn.lower(avals).compile()``)
     needs instead of concrete arrays.  Must mirror ``init_fn``'s output
@@ -636,7 +777,13 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
     import jax
 
     width, arity = tensor.width, tensor.max_actions
-    qalloc = qcap + batch * arity * (2 if por else 1)
+    m = batch * arity
+    if por:
+        qalloc = qcap + 2 * m
+    elif spill:
+        qalloc = qcap + max(spill[1], m)
+    else:
+        qalloc = qcap + m
     sds = jax.ShapeDtypeStruct
     avals = (
         sds((cap,), jnp.uint64), sds((cap,), jnp.uint64),
@@ -651,6 +798,16 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
         avals = avals + (sds((), jnp.bool_),)
     if por:
         avals = avals + (sds((), jnp.int32), sds((3,), jnp.int64))
+    if spill:
+        spill_bits, pend_cap = spill
+        palloc = pend_cap + batch * arity
+        avals = avals + (
+            sds((spill_bits // 32,), jnp.uint32), sds((), jnp.int64),
+            sds((palloc,), jnp.uint64), sds((palloc, width), jnp.uint64),
+            sds((palloc,), jnp.uint64), sds((palloc,), jnp.uint32),
+            sds((palloc,), jnp.uint32), sds((), jnp.int32),
+            sds((2,), jnp.int64),
+        )
     if cartography:
         from ..ops.cartography import cart_carry_shapes
 
@@ -658,6 +815,68 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
             sds(s, jnp.int64) for s in cart_carry_shapes(arity, n_props)
         )
     return avals
+
+
+def _build_inject(tensor, cap: int, qcap: int, batch: int,
+                  pallas: bool, sym: bool, checked: bool, spill):
+    """Jitted pending-injection program for the spill tier: insert one
+    host-VERIFIED batch of novel ``(fp, row, parent, ebits, depth)``
+    tuples into the hot table + queue, bump ``unique``/``tail``, and
+    clear the pending count — the device half of pending resolution
+    (``TpuChecker._resolve_pending``).  The insert dedups against the
+    hot table exactly like a step insert, so a Bloom false positive
+    whose fingerprint was meanwhile injected simply drops out.  Growth
+    statuses mirror the step's; on a table overflow NOTHING is written
+    and the host evicts-or-grows and retries."""
+    width, arity = tensor.width, tensor.max_actions
+    spill_bits, pend_cap = spill
+    spill_start = (_ERR + 1) if checked else _ERR  # por never composes
+
+    @jax.jit
+    def inject_fn(carry, ifp, irows, ipar, iebt, idep, n):
+        (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+         unique, scount, disc, maxdepth, status) = carry[:_ERR]
+        live = jnp.arange(pend_cap, dtype=jnp.int32) < n
+        cfp = jnp.where(live, ifp, EMPTY)
+        tfp, tpl, sel, n_new, tovf, _ = bucket_insert(
+            tfp, tpl, cfp, ipar, window=min(batch, pend_cap),
+            use_pallas=pallas, generation_order=sym,
+        )
+        qrows = jax.lax.dynamic_update_slice(
+            qrows, irows[sel], (tail, jnp.int32(0))
+        )
+        qfp = jax.lax.dynamic_update_slice(qfp, cfp[sel], (tail,))
+        qebits = jax.lax.dynamic_update_slice(qebits, iebt[sel], (tail,))
+        qdepth = jax.lax.dynamic_update_slice(qdepth, idep[sel], (tail,))
+        tail = tail + n_new
+        unique = unique + n_new.astype(jnp.int64)
+        base = carry[spill_start + _SP_BASE]
+        status = jnp.where(
+            status == jnp.int32(_STATUS_SPILL_SYNC),
+            jnp.int32(_STATUS_OK), status,
+        )
+        status = jnp.where(
+            tovf | ((unique - base) * 4 > cap),
+            jnp.int32(_STATUS_TABLE_FULL),
+            jnp.where(
+                tail > qcap, jnp.int32(_STATUS_QUEUE_FULL), status
+            ),
+        )
+        out = (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
+               unique, scount, disc, maxdepth, status)
+        if checked:
+            out = out + (carry[_ERR],)
+        st = spill_start
+        out = out + (
+            carry[st + _SP_BLOOM], base, carry[st + _SP_PFP],
+            carry[st + _SP_PROWS], carry[st + _SP_PPAR],
+            carry[st + _SP_PEBT], carry[st + _SP_PDEP],
+            jnp.int32(0), carry[st + _SP_STATS],
+        )
+        out = out + tuple(carry[st + _SPILL_LEN:])
+        return out, jnp.stack([n_new, tovf.astype(jnp.int32)])
+
+    return inject_fn
 
 
 def _aot_compile(run_fn, avals):
@@ -712,10 +931,19 @@ class TpuChecker(WavefrontChecker):
         resume: Optional[dict] = None,
         pallas: Optional[bool] = None,
         cand: Optional[int] = None,
+        spill_bloom_bits: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        spill_host_bytes: Optional[int] = None,
     ):
         import os
 
         self._cap = max(_pow2(capacity), 4 * SLOTS)
+        # spill-tier knobs (docs/spill.md); consumed by _init_spill when
+        # the builder armed the tier (CheckerBuilder.spill() / --spill /
+        # STATERIGHT_TPU_SPILL=1, resolved in _init_common)
+        self._spill_bloom_bits = spill_bloom_bits
+        self._spill_dir = spill_dir
+        self._spill_host_bytes = spill_host_bytes
         if pallas is None:
             pallas = os.environ.get("STATERIGHT_TPU_PALLAS", "") == "1"
         self._pallas = bool(pallas)
@@ -746,9 +974,15 @@ class TpuChecker(WavefrontChecker):
         return cache
 
     def _engine_key(self, cap, qcap, batch, cand) -> tuple:
-        return (cap, qcap, batch, cand, self._steps, self._target,
-                self._pallas, self._symmetry is not None, self._checked,
-                self._prededup, self._cartography, self._por)
+        # spill OFF leaves the key exactly the pre-spill tuple (and the
+        # step jaxpr bit-identical): the engine cache — in-memory and the
+        # persistent XLA cache both — is unkeyed by the feature's absence
+        key = (cap, qcap, batch, cand, self._steps, self._target,
+               self._pallas, self._symmetry is not None, self._checked,
+               self._prededup, self._cartography, self._por)
+        if self._spill:
+            key = key + (("spill",) + self._spill_cfg)
+        return key
 
     def _build(self, cap, qcap, batch, cand):
         return _build_engine(
@@ -758,6 +992,7 @@ class TpuChecker(WavefrontChecker):
             checked=self._checked, prededup=self._prededup,
             cartography=self._cartography,
             por=self._por_plan if self._por else None,
+            spill=self._spill_cfg if self._spill else None,
         )
 
     # -- memory-ledger hooks (telemetry/memory.py) ---------------------------
@@ -770,6 +1005,7 @@ class TpuChecker(WavefrontChecker):
 
         tensor, n_props = self.tensor, len(self._props)
         checked, cart, por = self._checked, self._cartography, self._por
+        spill = self._spill_cfg if self._spill else None
         batch = self._batch
 
         def spec_fn(caps):
@@ -777,7 +1013,7 @@ class TpuChecker(WavefrontChecker):
                 tensor, n_props, int(caps["cap"]),
                 int(caps.get("qcap", max(int(caps["cap"]) // 2, 1))),
                 int(caps.get("batch", batch)),
-                checked=checked, cartography=cart, por=por,
+                checked=checked, cartography=cart, por=por, spill=spill,
             )
 
         return spec_fn
@@ -794,9 +1030,28 @@ class TpuChecker(WavefrontChecker):
         return (_ERR + 1) if self._checked else _ERR
 
     @property
+    def _spill_start(self) -> int:
+        """Carry index of the spill tail (bloom, base, pending, stats)."""
+        return self._por_start + (2 if self._por else 0)
+
+    @property
     def _cart_start(self) -> int:
         """Carry index where the cartography counter tail begins."""
-        return self._por_start + (2 if self._por else 0)
+        return self._spill_start + (_SPILL_LEN if self._spill else 0)
+
+    def _bank_depth_lanes(self, qdepth, n: int, sign: int = 1) -> None:
+        """Fold the depth lanes of ``qdepth[:n]`` into the cartography
+        depth bank (``sign=-1`` un-banks) — the ONE definition of the
+        banking rule shared by growth compaction, queue offload, and
+        refill, so ``sum(depth_hist) == unique`` cannot silently break
+        at one forgotten site.  No-op when cartography is off."""
+        if not self._cartography or n <= 0:
+            return
+        from ..ops.cartography import DEPTH_BINS, queue_depth_hist_np
+
+        if self._cart_depth_base is None:
+            self._cart_depth_base = np.zeros(DEPTH_BINS, np.int64)
+        self._cart_depth_base += sign * queue_depth_hist_np(qdepth, n)
 
     def _sync_cartography(self, tail, *, states: int, unique: int) -> None:
         """Parse the cartography section of the packed stats vector (the
@@ -827,6 +1082,467 @@ class TpuChecker(WavefrontChecker):
         self._live_cart = snap
         if self.flight_recorder is not None:
             self.flight_recorder.set_cartography(snap)
+
+    # -- spill tier (stateright_tpu/spill/; docs/spill.md) -------------------
+
+    def _init_spill(self) -> None:
+        """Arm the host/disk overflow tiers for this run; called from
+        ``_init_common`` once the builder flag resolved true.  Everything
+        here is host state — the device half is the carry tail the
+        engine builder appends when ``spill`` is set."""
+        import os as _os
+
+        from ..spill import SpillStore
+        from ..spill.bloom import MAX_BLOOM_BITS, MIN_BLOOM_BITS
+
+        bits = self._spill_bloom_bits
+        if not bits:
+            env = _os.environ.get(
+                "STATERIGHT_TPU_SPILL_BLOOM_BITS", ""
+            ).strip()
+            if env and not env.isdigit():
+                import sys as _sys
+
+                print(
+                    "stateright-tpu: spill: ignoring malformed "
+                    f"STATERIGHT_TPU_SPILL_BLOOM_BITS={env!r} (want "
+                    "plain bits, e.g. 8388608); using the default",
+                    file=_sys.stderr,
+                )
+            bits = int(env) if env.isdigit() else (1 << 23)
+        bits = min(max(_pow2(int(bits)), MIN_BLOOM_BITS), MAX_BLOOM_BITS)
+        m = self._batch * self.tensor.max_actions
+        # pending capacity = FOUR expansion windows: the stop rule
+        # (pend_count + m > pend_cap halts the block) then lets several
+        # deferring batches run per host sync instead of forcing a
+        # resolve round-trip after every one (post-eviction, nearly
+        # every window defers something — one-window capacity collapsed
+        # steps_per_call batching to 1), while the over-allocated buffer
+        # (pend_cap + m) still never clamps a write; the queue's append
+        # slack is widened to match the inject window (_qalloc)
+        self._spill_cfg = (bits, 4 * m)
+        self._spill_store = SpillStore(
+            directory=self._spill_dir, host_budget=self._spill_host_bytes
+        )
+        self._spill_bloom_np = np.zeros(bits // 32, np.uint32)
+        self._spill_qrows: list = []  # host FIFO of offloaded queue chunks
+        self._spill_tally = {
+            "evictions": 0, "resolved_dups": 0, "resolved_novel": 0,
+            "queue_offloaded": 0, "queue_refilled": 0, "deferred": 0,
+            "on_device": 0,
+        }
+        self._inject_cache: dict = {}
+
+    def _spill_snapshot(self) -> dict:
+        """Live spill-tier status (JSON-safe): tier bytes, Bloom load,
+        deferral/resolution tallies — the block telemetry/report/watch/
+        Explorer all read."""
+        from ..spill import SPILL_V
+        from ..spill.bloom import BLOOM_K, bloom_est_false_pos
+
+        bits, pend_cap = self._spill_cfg
+        store = self._spill_store
+        t = self._spill_tally
+        q_host = sum(int(c[1].shape[0]) for c in self._spill_qrows)
+        return {
+            "v": SPILL_V,
+            "enabled": True,
+            "evictions": t["evictions"],
+            "spilled_fps": len(store),
+            "host_bytes": store.host_bytes,
+            "disk_bytes": store.disk_bytes,
+            "index_bytes": store.index_bytes,
+            "bloom_bits": bits,
+            "bloom_k": BLOOM_K,
+            "bloom_est_false_pos": round(
+                bloom_est_false_pos(len(store), bits), 6
+            ),
+            "pend_cap": pend_cap,
+            "deferred": t["deferred"],
+            "on_device": t["on_device"],
+            "resolved_dups": t["resolved_dups"],
+            "resolved_novel": t["resolved_novel"],
+            "queue_offloaded": t["queue_offloaded"],
+            "queue_refilled": t["queue_refilled"],
+            "queue_host_rows": q_host,
+        }
+
+    def _refresh_spill(self) -> None:
+        if self.flight_recorder is not None:
+            self.flight_recorder.set_spill(self._spill_snapshot())
+
+    def spill_status(self) -> Optional[dict]:
+        """Spill-tier status of this run, or None when ``spill()`` was
+        never requested: evictions, per-tier bytes, Bloom parameters and
+        estimated false-positive rate, deferral/resolution tallies."""
+        if not getattr(self, "_spill", False):
+            return None
+        return self._spill_snapshot()
+
+    def _spill_fits_transient(self, cur_caps: dict, new_caps: dict) -> bool:
+        """Does the growth migration ``cur -> new`` (both carries live
+        across the swap) fit the device budget?  No budget known — or no
+        analytic model — means growth proceeds as ever (the tier only
+        changes behavior where PR 7's ledger can prove the wall)."""
+        from ..telemetry.memory import device_budget
+
+        budget, _ = device_budget()
+        if budget is None:
+            return True
+        cur = self._analytic_footprint_bytes(cur_caps)
+        nxt = self._analytic_footprint_bytes(new_caps)
+        if cur is None or nxt is None:
+            return True
+        return cur + nxt <= budget
+
+    def _spill_should_evict(self, cap, qcap, batch) -> bool:
+        """Evict instead of growing iff the NEXT table rung's migration
+        transient (PR 7's ``next_rung.transient_bytes``) exceeds the
+        device budget."""
+        return not self._spill_fits_transient(
+            {"cap": cap, "qcap": qcap, "batch": batch},
+            {"cap": cap * 2, "qcap": qcap, "batch": batch},
+        )
+
+    def _evict_hot_table(self, carry_np: list, tail_extra: list) -> list:
+        """Sweep the hot table into the host tier at a growth boundary:
+        append every occupied ``(fp, parent)`` to the spill store, fold
+        the evicted fingerprints into the Bloom mirror, clear the hot
+        table in place, and refresh the carry's bloom/base tail elements.
+        Exactness: evicted fingerprints remain reachable through the
+        Bloom -> pending -> host-index path, and their parents merge back
+        at trace reconstruction (``_parents``)."""
+        from ..spill import SPILL_V
+        from ..spill.bloom import bloom_est_false_pos, bloom_set_np
+
+        tfp, tpl = carry_np[_TFP], carry_np[_TPL]
+        occ = tfp != np.uint64(EMPTY)
+        fps, pars = tfp[occ], tpl[occ]
+        self._spill_store.append(fps, pars)
+        bloom_set_np(self._spill_bloom_np, fps)
+        carry_np[_TFP] = np.full(tfp.shape, EMPTY, np.uint64)
+        carry_np[_TPL] = np.zeros(tpl.shape, np.uint64)
+        off = self._spill_start - _ERR
+        tail_extra = list(tail_extra)
+        tail_extra[off + _SP_BLOOM] = jnp.asarray(self._spill_bloom_np)
+        tail_extra[off + _SP_BASE] = jnp.int64(len(self._spill_store))
+        self._spill_tally["evictions"] += 1
+        rec = self.flight_recorder
+        if rec is not None:
+            bits, _ = self._spill_cfg
+            rec.add("spill_evictions")
+            rec.record(
+                "spill", v=SPILL_V, event="evict",
+                evicted=int(fps.size),
+                spilled_fps=len(self._spill_store),
+                host_bytes=self._spill_store.host_bytes,
+                disk_bytes=self._spill_store.disk_bytes,
+                bloom_bits=bits,
+                bloom_est_false_pos=round(
+                    bloom_est_false_pos(len(self._spill_store), bits), 6
+                ),
+            )
+            self._refresh_spill()
+        return tail_extra
+
+    def _inject(self, cap, qcap, batch):
+        """The compiled pending-injection program for these capacities
+        (rebuilt per growth rung, like the engine)."""
+        key = (cap, qcap, batch)
+        fn = self._inject_cache.get(key)
+        if fn is None:
+            fn = _build_inject(
+                self.tensor, cap, qcap, batch, self._pallas,
+                self._symmetry is not None, self._checked, self._spill_cfg,
+            )
+            self._inject_cache[key] = fn
+        return fn
+
+    def _resolve_pending(self, carry, cap, qcap, batch, cand):
+        """Resolve the device pending buffer against the host index:
+        fingerprints the store knows are duplicates (Bloom true
+        positives) and drop out; the rest (false positives) are novel
+        and re-enter the hot table + queue through the jitted inject
+        program.  A hot table too full to take them evicts-or-grows and
+        retries — nothing is ever lost.  Returns ``(cap, qcap, carry)``.
+        """
+        from ..spill import SPILL_V
+
+        st = self._spill_start
+        bits, pend_cap = self._spill_cfg
+        n = int(np.asarray(carry[st + _SP_PCOUNT]))
+        if n == 0:
+            return cap, qcap, carry
+        pfp = np.asarray(carry[st + _SP_PFP])[:n]
+        prows = np.asarray(carry[st + _SP_PROWS])[:n]
+        ppar = np.asarray(carry[st + _SP_PPAR])[:n]
+        pebt = np.asarray(carry[st + _SP_PEBT])[:n]
+        pdep = np.asarray(carry[st + _SP_PDEP])[:n]
+        rec = self.flight_recorder
+        if rec is not None:
+            rec.add_bytes(d2h=pfp.nbytes + prows.nbytes + ppar.nbytes
+                          + pebt.nbytes + pdep.nbytes)
+        valid = pfp != np.uint64(EMPTY)
+        pfp, prows = pfp[valid], prows[valid]
+        ppar, pebt, pdep = ppar[valid], pebt[valid], pdep[valid]
+        # intra-batch dedup, keep-FIRST occurrence: the earliest
+        # generation wins the parent/ebits/depth payload, exactly the
+        # lane the insert's stable sort would have kept
+        _, first = np.unique(pfp, return_index=True)
+        first.sort()
+        seen = self._spill_store.contains(pfp[first])
+        novel_idx = first[~seen]
+        k = int(novel_idx.size)
+        dups = n - k
+        injected = 0
+        if k == 0:
+            # nothing to inject: clear the count with cheap eager updates
+            carry = list(carry)
+            carry[st + _SP_PCOUNT] = jnp.int32(0)
+            if int(np.asarray(carry[_STATUS])) == _STATUS_SPILL_SYNC:
+                carry[_STATUS] = jnp.int32(_STATUS_OK)
+        else:
+            nfp = pfp[novel_idx]
+            nrows = prows[novel_idx]
+            npar = ppar[novel_idx]
+            nebt = pebt[novel_idx]
+            ndep = pdep[novel_idx]
+            while True:
+                ifp = np.full(pend_cap, EMPTY, np.uint64)
+                irows = np.zeros((pend_cap, self.tensor.width), np.uint64)
+                ipar = np.zeros(pend_cap, np.uint64)
+                iebt = np.zeros(pend_cap, np.uint32)
+                idep = np.zeros(pend_cap, np.uint32)
+                ifp[:k] = nfp
+                irows[:k] = nrows
+                ipar[:k] = npar
+                iebt[:k] = nebt
+                idep[:k] = ndep
+                args = tuple(jnp.asarray(a) for a in
+                             (ifp, irows, ipar, iebt, idep))
+                out, io = self._inject(cap, qcap, batch)(
+                    tuple(carry), *args, jnp.int32(k)
+                )
+                io = np.asarray(io)
+                carry = list(out)
+                if int(io[1]) == 0:
+                    # tally what actually ENTERED the hot table: the
+                    # inject's dedup drops hot-resident Bloom false
+                    # positives, which count as duplicates, not novel
+                    injected = int(io[0])
+                    dups += k - injected
+                    break
+                # the hot table cannot take the batch: evict-or-grow,
+                # rebuild the inject program for the new rung, retry
+                cap, qcap, carry = self._spill_inject_boundary(
+                    carry, cap, qcap, batch, cand
+                )
+                # the boundary may have EVICTED: pending fps that were
+                # hot-resident (Bloom false positives the inject's
+                # hot-table dedup would have dropped) are now in the
+                # store, and retrying the original batch against the
+                # emptied table would insert them a SECOND time —
+                # re-filter against the store before every retry
+                seen2 = self._spill_store.contains(nfp)
+                if seen2.any():
+                    keep = ~seen2
+                    dups += int(seen2.sum())
+                    nfp, nrows = nfp[keep], nrows[keep]
+                    npar, nebt, ndep = npar[keep], nebt[keep], ndep[keep]
+                    k = int(nfp.size)
+                    if k == 0:
+                        # nothing left to inject; the boundary already
+                        # cleared the status and the inject that
+                        # overflowed cleared the pending count
+                        break
+        self._spill_tally["resolved_dups"] += dups
+        self._spill_tally["resolved_novel"] += injected
+        if rec is not None:
+            rec.record(
+                "spill", v=SPILL_V, event="resolve",
+                pending=n, dups=dups, novel=injected,
+            )
+            self._refresh_spill()
+        return cap, qcap, carry
+
+    def _spill_inject_boundary(self, carry, cap, qcap, batch, cand):
+        """Growth boundary hit from inside pending injection (the hot
+        table overflowed taking the batch): evict under budget pressure,
+        else grow — the same decision the step boundary makes."""
+        arity = self.tensor.max_actions
+        tail_extra = list(carry[_ERR:])
+        carry_np = [np.asarray(c) for c in carry[:_ERR]]
+        status = _STATUS_TABLE_FULL
+        if self._spill_should_evict(cap, qcap, batch):
+            tail_extra = self._evict_hot_table(carry_np, tail_extra)
+            status = _STATUS_OK
+        carry_np[_STATUS] = np.int32(_STATUS_OK)
+        cap, qcap, carry_np = self._grow(
+            carry_np, cap, qcap, batch, arity, status, cand
+        )
+        return cap, qcap, [jnp.asarray(c) for c in carry_np] + tail_extra
+
+    def _offload_queue_tail(self, carry_np: list, pending: int,
+                            qcap: int) -> int:
+        """The queue outgrew a budget-blocked doubling: move the tail
+        excess (the rows furthest from being popped) to the host FIFO;
+        they re-enter via ``_queue_refill`` when the device queue drains.
+        Called from ``_grow`` AFTER the consumed-prefix compaction, so
+        live rows sit at ``[0:pending]``."""
+        from ..spill import SPILL_V
+
+        keep = max(qcap // 2, 1)
+        if pending <= keep:
+            return pending
+        chunk = tuple(
+            np.asarray(carry_np[i][keep:pending]).copy()
+            for i in (_QROWS, _QFP, _QEBITS, _QDEPTH)
+        )
+        self._spill_qrows.append(chunk)
+        # the offloaded rows leave qdepth[:tail], which the queue-derived
+        # depth histogram is computed from: bank their lanes (un-banked
+        # at refill, where they re-enter) so sum(depth_hist) == unique
+        # holds at every sync — including a run that ends (target hit,
+        # all props discovered) with rows still in the host FIFO
+        self._bank_depth_lanes(chunk[3], int(chunk[3].shape[0]))
+        carry_np[_TAIL] = np.int32(keep)
+        moved = pending - keep
+        self._spill_tally["queue_offloaded"] += moved
+        rec = self.flight_recorder
+        if rec is not None:
+            rec.record(
+                "spill", v=SPILL_V, event="queue_offload", rows=moved,
+                host_rows=sum(int(c[1].shape[0])
+                              for c in self._spill_qrows),
+            )
+            self._refresh_spill()
+        return keep
+
+    def _queue_refill(self, carry, cap, qcap, batch):
+        """The device queue drained while host-offloaded frontier rows
+        remain: compact, append up to the high-water mark's worth from
+        the host FIFO, and continue.  The carry crosses to the host here
+        — rare by construction (once per ``qcap`` drained rows)."""
+        from ..spill import SPILL_V
+
+        tail_extra = list(carry[_ERR:])
+        carry_np = [np.asarray(c).copy() for c in carry[:_ERR]]
+        head, tail = int(carry_np[_HEAD]), int(carry_np[_TAIL])
+        self._bank_depth_lanes(carry_np[_QDEPTH], head)
+        for i in (_QROWS, _QFP, _QEBITS, _QDEPTH):
+            carry_np[i] = carry_np[i][head:tail].copy()
+        pending = tail - head
+        room = qcap - pending
+        taken = [[], [], [], []]
+        moved = 0
+        while self._spill_qrows and room > 0:
+            chunk = self._spill_qrows[0]
+            cn = int(chunk[1].shape[0])
+            if cn <= room:
+                self._spill_qrows.pop(0)
+                take = chunk
+            else:
+                take = tuple(a[:room] for a in chunk)
+                self._spill_qrows[0] = tuple(a[room:] for a in chunk)
+            for j in range(4):
+                taken[j].append(take[j])
+            cn = int(take[1].shape[0])
+            # un-bank the refilled rows' depth lanes: they re-enter
+            # qdepth[:tail], where the histogram derivation counts them
+            # (the offload banked them — see _offload_queue_tail)
+            self._bank_depth_lanes(take[3], cn, sign=-1)
+            moved += cn
+            room -= cn
+        for j, i in enumerate((_QROWS, _QFP, _QEBITS, _QDEPTH)):
+            carry_np[i] = np.concatenate([carry_np[i]] + taken[j])
+        carry_np[_HEAD] = np.int32(0)
+        carry_np[_TAIL] = np.int32(pending + moved)
+        _repad_queue(carry_np, self._qalloc(qcap, batch))
+        self._spill_tally["queue_refilled"] += moved
+        rec = self.flight_recorder
+        if rec is not None:
+            rec.record(
+                "spill", v=SPILL_V, event="queue_refill", rows=moved,
+                host_rows=sum(int(c[1].shape[0])
+                              for c in self._spill_qrows),
+            )
+            self._refresh_spill()
+        return [jnp.asarray(c) for c in carry_np] + tail_extra
+
+    def _restore_spill_host(self, snap: dict) -> None:
+        """Restore the HOST half of the spill tier from the snapshot
+        manifest (store, Bloom mirror, offloaded-queue FIFO, config) —
+        called from ``_snapshot_to_carry`` BEFORE any growth handling,
+        which reads ``len(self._spill_store)`` as the spill base."""
+        from ..spill.bloom import MAX_BLOOM_BITS, bloom_set_np
+
+        if "spill_bloom_bits" in snap:
+            bits = min(_pow2(int(snap["spill_bloom_bits"])), MAX_BLOOM_BITS)
+            if bits != self._spill_cfg[0]:
+                self._spill_cfg = (bits, self._spill_cfg[1])
+                self._spill_bloom_np = np.zeros(bits // 32, np.uint32)
+        # batch travels with the snapshot and governs the window size
+        # (keep the four-window pending sizing of _init_spill)
+        m = self._batch * self.tensor.max_actions
+        self._spill_cfg = (self._spill_cfg[0], 4 * m)
+        f = snap.get("spill_fp")
+        if f is not None:
+            self._spill_store.append(
+                np.asarray(f, np.uint64),
+                np.asarray(snap["spill_parent"], np.uint64),
+            )
+            bloom_set_np(self._spill_bloom_np, np.asarray(f, np.uint64))
+        if "spill_q_fp" in snap:
+            self._spill_qrows.append(tuple(
+                np.asarray(snap[k])
+                for k in ("spill_q_rows", "spill_q_fp", "spill_q_ebits",
+                          "spill_q_depth")
+            ))
+
+    def _spill_resume_tail(self, snap: dict) -> list:
+        """Rebuild the spill CARRY tail at resume (host state already
+        restored by ``_restore_spill_host``): the Bloom + base from the
+        restored store, pending from the snapshot's mid-resolution
+        buffer (if the checkpoint landed on a growth boundary with
+        candidates still deferred)."""
+        bits, pend_cap = self._spill_cfg
+        m = self._batch * self.tensor.max_actions
+        palloc = pend_cap + m
+        width = self.tensor.width
+        pfp = np.full(palloc, EMPTY, np.uint64)
+        prows = np.zeros((palloc, width), np.uint64)
+        ppar = np.zeros(palloc, np.uint64)
+        pebt = np.zeros(palloc, np.uint32)
+        pdep = np.zeros(palloc, np.uint32)
+        pn = 0
+        if "spill_pend_fp" in snap:
+            pf = np.asarray(snap["spill_pend_fp"], np.uint64)
+            pn = min(int(pf.size), pend_cap)
+            pfp[:pn] = pf[:pn]
+            prows[:pn] = np.asarray(snap["spill_pend_rows"])[:pn]
+            ppar[:pn] = np.asarray(snap["spill_pend_parent"])[:pn]
+            pebt[:pn] = np.asarray(snap["spill_pend_ebits"])[:pn]
+            pdep[:pn] = np.asarray(snap["spill_pend_depth"])[:pn]
+        return [
+            jnp.asarray(self._spill_bloom_np),
+            jnp.int64(len(self._spill_store)),
+            jnp.asarray(pfp), jnp.asarray(prows), jnp.asarray(ppar),
+            jnp.asarray(pebt), jnp.asarray(pdep), jnp.int32(pn),
+            jnp.zeros((2,), jnp.int64),
+        ]
+
+    def _parents(self) -> dict:
+        """Trace reconstruction merges every tier: host/disk-resident
+        parents first, then the hot table's (the sets are disjoint —
+        eviction removes what it spills)."""
+        if self._parent_map is None:
+            parents: dict = {}
+            if getattr(self, "_spill", False) and len(self._spill_store):
+                for fps, pars in self._spill_store.iter_segments():
+                    parents.update(zip(fps.tolist(), pars.tolist()))
+            parents.update(self._parents_from_table(*self._table_np()))
+            self._parent_map = parents
+        return self._parent_map
 
     def _engine(self, cap, qcap, batch, cand, kind: str = "growth"):
         """The compiled engine for these capacities, through (in order) the
@@ -907,6 +1623,7 @@ class TpuChecker(WavefrontChecker):
                     _carry_avals(
                         self.tensor, len(self._props), cap, qcap, batch,
                         self._checked, self._cartography, self._por,
+                        self._spill_cfg if self._spill else None,
                     ),
                 )
             except Exception:  # noqa: BLE001 - fall back to the lazy path;
@@ -973,6 +1690,7 @@ class TpuChecker(WavefrontChecker):
                 continue
             checked, n_props = self._checked, len(self._props)
             cartography, por = self._cartography, self._por
+            spill = self._spill_cfg if self._spill else None
             tensor = self.tensor
 
             def build(ncap=ncap, nqcap=nqcap, ncand=ncand):
@@ -980,7 +1698,7 @@ class TpuChecker(WavefrontChecker):
                 exe = _aot_compile(
                     run_fn,
                     _carry_avals(tensor, n_props, ncap, nqcap, batch,
-                                 checked, cartography, por),
+                                 checked, cartography, por, spill),
                 )
                 return init_fn, exe
             if self._prewarmer.schedule(key, build):
@@ -1036,6 +1754,38 @@ class TpuChecker(WavefrontChecker):
             # them a resumed histogram forgets every state popped before
             # a pre-snapshot growth, breaking sum(depth_hist) == unique
             snap["cart_depth_base"] = self._cart_depth_base.copy()
+        if getattr(self, "_spill", False):
+            # the snapshot manifest carries the HOST/DISK tier contents
+            # (and any in-flight pending/offloaded rows) so a resumed run
+            # reconstructs the whole tiered visited set; footprint_bytes
+            # above stays HOT-TIER-ONLY — spill_* keys are host-resident
+            # and snapshot_fits_guard must not count them against HBM
+            snap["spill_bloom_bits"] = np.int64(self._spill_cfg[0])
+            snap["spill_base"] = np.int64(len(self._spill_store))
+            f, p = self._spill_store.to_arrays()
+            if f.size:
+                snap["spill_fp"], snap["spill_parent"] = f, p
+            if self._spill_qrows:
+                for j, k in enumerate(
+                    ("spill_q_rows", "spill_q_fp", "spill_q_ebits",
+                     "spill_q_depth")
+                ):
+                    snap[k] = np.concatenate(
+                        [c[j] for c in self._spill_qrows]
+                    )
+            st = self._spill_start
+            pn = int(np.asarray(carry[st + _SP_PCOUNT]))
+            if pn > 0:
+                snap["spill_pend_fp"] = np.asarray(
+                    carry[st + _SP_PFP])[:pn]
+                snap["spill_pend_rows"] = np.asarray(
+                    carry[st + _SP_PROWS])[:pn]
+                snap["spill_pend_parent"] = np.asarray(
+                    carry[st + _SP_PPAR])[:pn]
+                snap["spill_pend_ebits"] = np.asarray(
+                    carry[st + _SP_PEBT])[:pn]
+                snap["spill_pend_depth"] = np.asarray(
+                    carry[st + _SP_PDEP])[:pn]
         return snap
 
     def _pre_run_validate(self) -> None:
@@ -1044,9 +1794,14 @@ class TpuChecker(WavefrontChecker):
 
     def _qalloc(self, qcap: int, batch: int) -> int:
         """Queue allocation for these capacities — must mirror the
-        engine's (POR over-allocates a second append window)."""
+        engine's (POR over-allocates a second append window; the spill
+        inject's pend_cap-wide append governs when the tier is armed)."""
         m = batch * self.tensor.max_actions
-        return qcap + (2 * m if self._por else m)
+        if self._por:
+            return qcap + 2 * m
+        if self._spill:
+            return qcap + max(self._spill_cfg[1], m)
+        return qcap + m
 
     def _snapshot_to_carry(self, snap: dict):
         self._check_snapshot_sig(snap)
@@ -1054,6 +1809,10 @@ class TpuChecker(WavefrontChecker):
         qcap = int(snap["qcap"])
         self._batch = int(snap.get("batch", self._batch))
         self._cand = int(snap.get("cand", self._cand))
+        if self._spill:
+            # BEFORE any boundary growth below: _grow reads the restored
+            # store's length as the spill base (hot occupancy)
+            self._restore_spill_host(snap)
         qalloc = self._qalloc(qcap, self._batch)
         base = snap.get("cart_depth_base")
         if base is not None:
@@ -1077,9 +1836,19 @@ class TpuChecker(WavefrontChecker):
         step attempts) — NOT the fully padded ``4*batch*arity``, which would
         make the first growth event of any kind inflate the table to cover a
         width the candidate-compaction pipeline exists to avoid paying for.
+
+        With the spill tier armed, the table trigger reads HOT occupancy
+        (``unique - spilled``) and a budget-blocked queue doubling
+        offloads the tail excess to the host FIFO instead of growing.
         """
+        spill_base = (
+            len(self._spill_store) if getattr(self, "_spill", False) else 0
+        )
+
         def table_small():
-            return (int(carry_np[_UNIQUE]) * 4 > cap) or (cand * 4 > cap)
+            return (
+                (int(carry_np[_UNIQUE]) - spill_base) * 4 > cap
+            ) or (cand * 4 > cap)
 
         if table_small() or status == _STATUS_TABLE_FULL:
             if table_small():
@@ -1093,25 +1862,29 @@ class TpuChecker(WavefrontChecker):
             carry_np[_TFP], carry_np[_TPL] = tfp, tpl
         head, tail = int(carry_np[_HEAD]), int(carry_np[_TAIL])
         pending = tail - head
-        if self._cartography and head > 0:
-            # the compaction below drops the consumed queue prefix — bank
-            # its depth lanes first, or the queue-derived histogram
-            # (ops/cartography.queue_depth_hist) would forget every state
-            # popped before this growth.  Free: the carry is already on
-            # the host here.
-            from ..ops.cartography import DEPTH_BINS, queue_depth_hist_np
-
-            if self._cart_depth_base is None:
-                self._cart_depth_base = np.zeros(DEPTH_BINS, np.int64)
-            self._cart_depth_base += queue_depth_hist_np(
-                carry_np[_QDEPTH], head
-            )
+        # the compaction below drops the consumed queue prefix — bank its
+        # depth lanes first, or the queue-derived histogram
+        # (ops/cartography.queue_depth_hist) would forget every state
+        # popped before this growth.  Free: the carry is already on the
+        # host here.
+        self._bank_depth_lanes(carry_np[_QDEPTH], head)
         # reclaim the consumed prefix; grow only if still needed
         for i in (_QROWS, _QFP, _QEBITS, _QDEPTH):
             carry_np[i] = carry_np[i][head:tail].copy()
         carry_np[_HEAD] = np.int32(0)
         carry_np[_TAIL] = np.int32(pending)
         while pending * 2 > qcap:
+            if getattr(self, "_spill", False) and not (
+                self._spill_fits_transient(
+                    {"cap": cap, "qcap": qcap, "batch": batch},
+                    {"cap": cap, "qcap": qcap * 2, "batch": batch},
+                )
+            ):
+                # budget-blocked queue doubling: the frontier's tail
+                # excess moves to the host FIFO instead (re-injected by
+                # _queue_refill when the device queue drains)
+                pending = self._offload_queue_tail(carry_np, pending, qcap)
+                break
             qcap *= 2
         carry_np[_STATUS] = np.int32(_STATUS_OK)
         _repad_queue(carry_np, self._qalloc(qcap, batch))
@@ -1204,6 +1977,11 @@ class TpuChecker(WavefrontChecker):
                 carry = list(carry) + [
                     jnp.int32(1), jnp.zeros((3,), jnp.int64)
                 ]
+            if self._spill:
+                # the spill tail re-seeds from the snapshot's host-tier
+                # manifest: store + Bloom rebuilt, pending restored (a
+                # boundary checkpoint can carry deferred candidates)
+                carry = list(carry) + self._spill_resume_tail(self._resume)
             if self._cartography:
                 # snapshots never carry the counters either: a resumed run
                 # restarts its per-step tallies at zero (totals keep
@@ -1240,14 +2018,27 @@ class TpuChecker(WavefrontChecker):
         disc_len = max(len(self._props), 1)
         cart_start = self._cart_start if self._cartography else None
         por_start = self._por_start if self._por else None
+        spill_start = self._spill_start if self._spill else None
         if rec is not None:
             rec.update_meta(
                 batch=batch, steps_per_call=self._steps, pallas=self._pallas,
             )
+            if self._spill:
+                from ..spill import SPILL_V
+                from ..telemetry.memory import device_budget
+
+                budget, _src = device_budget()
+                rec.record(
+                    "spill", v=SPILL_V, event="arm",
+                    bloom_bits=self._spill_cfg[0],
+                    pend_cap=self._spill_cfg[1],
+                    **({"budget_bytes": int(budget)} if budget else {}),
+                )
+                self._refresh_spill()
         while True:
             # one host sync per iteration: the packed stats vector
             if stats is None:
-                stats = _stats_np(carry, cart_start, por_start)
+                stats = _stats_np(carry, cart_start, por_start, spill_start)
             head, tail, unique, scount, maxdepth, status = (
                 int(stats[_ST_HEAD]), int(stats[_ST_TAIL]),
                 int(stats[_ST_UNIQUE]), int(stats[_ST_SCOUNT]),
@@ -1263,6 +2054,14 @@ class TpuChecker(WavefrontChecker):
                     stats[tail_off:tail_off + 3]
                 )
                 tail_off += 3
+            pend_live, spilled_live = 0, 0
+            if self._spill:
+                sp = stats[tail_off:tail_off + _SPILL_STATS_SECTION]
+                pend_live = int(sp[0])
+                spilled_live = int(sp[1])
+                self._spill_tally["deferred"] = int(sp[2])
+                self._spill_tally["on_device"] = int(sp[3])
+                tail_off += _SPILL_STATS_SECTION
             if self._cartography:
                 self._sync_cartography(
                     stats[tail_off:], states=scount, unique=unique
@@ -1280,7 +2079,9 @@ class TpuChecker(WavefrontChecker):
                     engine="wavefront", states=scount, unique=unique,
                     depth=maxdepth, status=status,
                     queue=max(tail - head, 0), cap=cap, cand=cand,
-                    load_factor=round(unique / cap, 6),
+                    # HOT occupancy with the spill tier armed: evicted
+                    # uniques live off-device (spilled_live is 0 otherwise)
+                    load_factor=round((unique - spilled_live) / cap, 6),
                 )
                 if occ_every and syncs % occ_every == 0:
                     self._telemetry_occupancy(
@@ -1294,14 +2095,32 @@ class TpuChecker(WavefrontChecker):
                         {"cap": cap, "qcap": qcap, "batch": batch},
                         extra={"queue_capacity": qcap},
                     )
-            # serve a pending checkpoint BEFORE growing: a request landing on
-            # a growth boundary snapshots the boundary carry (status != OK),
-            # and resume re-applies the growth (the flag travels with the
-            # snapshot — see the resume branch above)
+            # serve a pending checkpoint BEFORE growing OR resolving: a
+            # request landing on a growth boundary snapshots the boundary
+            # carry (status != OK) and resume re-applies the growth; one
+            # landing mid-deferral snapshots the pending buffer (the
+            # manifest carries it), so heavy Bloom traffic can never
+            # starve a checkpoint behind back-to-back resolutions
             if self._ckpt_req is not None and self._ckpt_req.is_set():
                 self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap, cand)
                 self._ckpt_req.clear()
                 self._ckpt_ready.set()
+            # spill pending resolution: every sync with deferred
+            # candidates (and a table/queue the inject can write into —
+            # growth boundaries resolve on the NEXT sync) looks them up
+            # in the host index and injects the Bloom false positives
+            if (
+                self._spill
+                and pend_live > 0
+                and status in (_STATUS_OK, _STATUS_SPILL_SYNC)
+            ):
+                t_sp = time.monotonic()
+                cap, qcap, carry = self._resolve_pending(
+                    carry, cap, qcap, batch, cand
+                )
+                self._stage("spill", time.monotonic() - t_sp)
+                stats = None
+                continue
             if status == _STATUS_POISON:
                 raise RuntimeError(
                     "poisoned rows reached by the device run: a compiled "
@@ -1366,6 +2185,18 @@ class TpuChecker(WavefrontChecker):
                     self._telemetry_occupancy(
                         carry_np[_TFP], at="growth", transferred=False
                     )
+                if (
+                    self._spill
+                    and status == _STATUS_TABLE_FULL
+                    and self._spill_should_evict(cap, qcap, batch)
+                ):
+                    # the tentpole move: the next rung's migration
+                    # transient does not fit the device budget, so the
+                    # hot table's contents spill to the host tier at
+                    # this boundary INSTEAD of growing (the cleared
+                    # table satisfies the trigger at the same capacity)
+                    tail_extra = self._evict_hot_table(carry_np, tail_extra)
+                    status = _STATUS_OK
                 cap, qcap, carry_np = self._grow(
                     carry_np, cap, qcap, batch, arity, status, cand
                 )
@@ -1379,10 +2210,27 @@ class TpuChecker(WavefrontChecker):
                 continue
             if self._stop.is_set():
                 break
+            all_disc = bool(self._props) and bool((disc != 0).all())
+            target_hit = self._target is not None and unique >= self._target
+            if (
+                self._spill
+                and tail <= head
+                and self._spill_qrows
+                and not all_disc
+                and not target_hit
+            ):
+                # the device queue drained but host-offloaded frontier
+                # rows remain: refill and keep going — the search is not
+                # done until every tier is empty
+                t_sp = time.monotonic()
+                carry = self._queue_refill(carry, cap, qcap, batch)
+                self._stage("spill", time.monotonic() - t_sp)
+                stats = None
+                continue
             done = tail <= head
-            if self._props and (disc != 0).all():
+            if all_disc:
                 done = True
-            if self._target is not None and unique >= self._target:
+            if target_hit:
                 done = True
             if done:
                 break
@@ -1414,6 +2262,21 @@ class TpuChecker(WavefrontChecker):
         }
         if self._por and self._live_por is not None:
             self._results["por"] = dict(self._live_por)
+        if self._spill:
+            from ..spill import SPILL_V
+
+            snap_sp = self._spill_snapshot()
+            self._results["spill"] = snap_sp
+            if rec is not None:
+                rec.record(
+                    "spill", v=SPILL_V, event="final",
+                    spilled_fps=snap_sp["spilled_fps"],
+                    host_bytes=snap_sp["host_bytes"],
+                    disk_bytes=snap_sp["disk_bytes"],
+                    dups=snap_sp["resolved_dups"],
+                    novel=snap_sp["resolved_novel"],
+                )
+                self._refresh_spill()
         if self._cartography and getattr(self, "_live_cart", None):
             self._results["cartography"] = self._live_cart
             if rec is not None:

@@ -1,0 +1,56 @@
+"""Billion-state spill tier: host-backed visited overflow (docs/spill.md).
+
+The ROADMAP's billion-state capacity item, second half (PR 7's HBM
+ledger is the measurement half): the visited set becomes a TIERED store
+
+ - **hot tier** — the existing HBM bucket table (``ops/buckets.py``),
+   unchanged;
+ - **host tier** — an append-only ``(fingerprint, parent)`` store in
+   host RAM (:class:`SpillStore`) with a host-side open-addressing hash
+   index (:class:`HostIndex`) for membership + offset lookup;
+ - **disk tier** — an mmap'd append-only segment file behind the host
+   tier, flushed to when the host tier passes its byte budget
+   (``STATERIGHT_TPU_HOST_BYTES``); the index stays in RAM.
+
+A device-side **Bloom filter** (``bloom.py``; bit-slices of
+``mix64(fp)``, GPUexplore-style) rides the step program's carry and
+answers "definitely not seen" on device: only Bloom-POSITIVE candidates
+are deferred to a pending buffer and resolved against the host index at
+the next host sync, so the common case never leaves the chip.
+
+Engine wiring lives in ``parallel/wavefront.py`` (``CheckerBuilder.
+spill()`` / ``--spill`` / ``STATERIGHT_TPU_SPILL=1``); this package is
+pure host/device data-structure code with no engine knowledge.
+"""
+
+from .bloom import (
+    BLOOM_K,
+    bloom_est_false_pos,
+    bloom_set_np,
+    bloom_test,
+    bloom_test_np,
+)
+from .store import (
+    BYTES_PER_ENTRY,
+    ENV_HOST_BYTES,
+    HostIndex,
+    SpillStore,
+    default_host_budget,
+)
+
+# spill status / ring-record schema version
+SPILL_V = 1
+
+__all__ = [
+    "BLOOM_K",
+    "BYTES_PER_ENTRY",
+    "ENV_HOST_BYTES",
+    "HostIndex",
+    "SPILL_V",
+    "SpillStore",
+    "bloom_est_false_pos",
+    "bloom_set_np",
+    "bloom_test",
+    "bloom_test_np",
+    "default_host_budget",
+]

@@ -1,0 +1,132 @@
+"""Device-side Bloom pre-filter over the SPILLED fingerprint set.
+
+The filter answers "definitely not seen off-device" inside the step
+program: a candidate that misses the hot table AND misses the Bloom is
+provably novel and inserts on device; only Bloom-positive candidates pay
+the host round-trip (GPUexplore's shape — a cheap device-resident
+pre-filter in front of off-device lookups, PAPERS.md).
+
+Hash family: bit-slices of ``mix64(fp)`` (one extra splitmix64 round —
+the same remix the bucket derivation uses, ``ops/buckets.bucket_key``)
+and of ``mix64(mix64(fp))``: four 32-bit slices masked down to the
+filter width.  The filter covers ONLY spilled fingerprints — hot-table
+membership is checked exactly by the insert pipeline — so the filter's
+load (and false-positive rate) tracks the spilled set, not the whole
+visited set.  Bits are set HOST-side at eviction boundaries (the carry
+is host-resident there anyway) and the device only ever TESTS, which
+keeps the step program read-only over the filter.
+
+No false negatives, ever: the host mirror (:func:`bloom_set_np`) and the
+device test (:func:`bloom_test`) derive bit positions from the same
+``mix64`` — pinned by test — so every spilled fingerprint tests
+positive and exactness reduces to the host index's verdict.
+
+False-positive math (docs/spill.md): with ``n`` spilled fingerprints,
+``k`` = :data:`BLOOM_K` slices and ``B`` filter bits, the expected rate
+is ``(1 - e^(-k*n/B))^k`` — at the default 8 Mbit filter and one million
+spilled states that is ~2.4%; a saturated filter degrades THROUGHPUT
+(everything defers to the host index), never correctness.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ops.hashing import mix64, mix64_np
+
+# hash functions per fingerprint: four 32-bit slices of two mix rounds
+BLOOM_K = 4
+
+# filter width floor: below this the u32 word array would be smaller
+# than one cache line and the whole exercise is noise
+MIN_BLOOM_BITS = 1 << 10
+
+# filter width ceiling: the device test gathers with int32 indices, so
+# bit positions must fit 2^31 — a wider filter would silently wrap
+# negative on device while the int64 host mirror stays correct,
+# manufacturing FALSE NEGATIVES (the one thing the filter must never
+# do).  2^31 bits = 256MB of HBM, far past any sane sizing; the engine
+# clamps requested widths here.
+MAX_BLOOM_BITS = 1 << 31
+
+
+def bloom_words(bits: int) -> int:
+    """u32 words backing a ``bits``-wide filter (``bits`` must be a
+    multiple of 32 — enforced by the power-of-two sizing)."""
+    assert bits % 32 == 0 and bits >= MIN_BLOOM_BITS
+    return bits // 32
+
+
+def bloom_est_false_pos(n_set: int, bits: int, k: int = BLOOM_K) -> float:
+    """Expected false-positive rate with ``n_set`` elements inserted."""
+    if bits <= 0 or n_set <= 0:
+        return 0.0
+    return float((1.0 - math.exp(-k * n_set / bits)) ** k)
+
+
+def _slices_np(fps: np.ndarray, bits: int) -> np.ndarray:
+    """``int64[k, n]`` bit positions for ``fps`` (host mirror; must match
+    the device derivation bit-for-bit)."""
+    fps = np.asarray(fps, np.uint64)
+    mask = np.uint64(bits - 1)
+    g1 = mix64_np(fps)
+    g2 = mix64_np(g1)
+    return np.stack([
+        (g1 & mask).astype(np.int64),
+        ((g1 >> np.uint64(32)) & mask).astype(np.int64),
+        (g2 & mask).astype(np.int64),
+        ((g2 >> np.uint64(32)) & mask).astype(np.int64),
+    ])
+
+
+def bloom_set_np(words: np.ndarray, fps) -> np.ndarray:
+    """Set the bits for ``fps`` in the host mirror ``words`` (u32 array),
+    in place; returns ``words``.  Called at eviction boundaries only."""
+    fps = np.asarray(fps, np.uint64)
+    if fps.size == 0:
+        return words
+    bits = int(words.size) * 32
+    idx = _slices_np(fps, bits).reshape(-1)
+    np.bitwise_or.at(
+        words, idx >> 5, (np.uint32(1) << (idx & 31).astype(np.uint32))
+    )
+    return words
+
+
+def bloom_test_np(words: np.ndarray, fps) -> np.ndarray:
+    """Host-side membership test (all k bits set); used by tests to pin
+    host/device agreement."""
+    fps = np.asarray(fps, np.uint64)
+    bits = int(words.size) * 32
+    idx = _slices_np(fps, bits)
+    hit = np.ones(fps.shape, bool)
+    for row in idx:
+        w = words[row >> 5]
+        hit &= ((w >> (row & 31).astype(np.uint32)) & np.uint32(1)) != 0
+    return hit
+
+
+def bloom_test(words: jnp.ndarray, fps: jnp.ndarray,
+               bits: int) -> jnp.ndarray:
+    """Device-side membership test: ``bool[...]`` per fingerprint, True
+    iff all :data:`BLOOM_K` slice bits are set.  Read-only over the
+    filter — the step program never writes it."""
+    mask = jnp.uint64(bits - 1)
+    g1 = mix64(fps)
+    g2 = mix64(g1)
+    hit = None
+    for h in (
+        g1 & mask,
+        (g1 >> jnp.uint64(32)) & mask,
+        g2 & mask,
+        (g2 >> jnp.uint64(32)) & mask,
+    ):
+        idx = h.astype(jnp.int32)
+        w = words[idx >> 5]
+        b = ((w >> (idx & 31).astype(jnp.uint32)) & jnp.uint32(1)) != 0
+        hit = b if hit is None else (hit & b)
+    return hit

@@ -168,6 +168,47 @@ def to_chrome_trace(rec: FlightRecorder, path) -> None:
                     "pid": pid,
                     "args": pressure,
                 })
+        elif r["kind"] == "spill":
+            # spill-tier events (docs/spill.md): the instant event keeps
+            # the record browsable; two counter tracks plot the tier byte
+            # series (spill_bytes) and the Bloom/pending traffic
+            # (bloom_filter) over the same timeline as the steps
+            events.append({
+                "name": r["kind"],
+                "cat": r["kind"],
+                "ph": "i",
+                "s": "p",
+                "ts": round(ts_us, 3),
+                "pid": pid,
+                "tid": 1,
+                "args": args,
+            })
+            sb = {}
+            for k in ("host_bytes", "disk_bytes"):
+                if r.get(k) is not None:
+                    sb[k] = r[k]
+            if sb:
+                events.append({
+                    "name": "spill_bytes",
+                    "cat": "spill",
+                    "ph": "C",
+                    "ts": round(ts_us, 3),
+                    "pid": pid,
+                    "args": sb,
+                })
+            bf = {}
+            for k in ("spilled_fps", "pending", "dups", "novel"):
+                if r.get(k) is not None:
+                    bf[k] = r[k]
+            if bf:
+                events.append({
+                    "name": "bloom_filter",
+                    "cat": "spill",
+                    "ph": "C",
+                    "ts": round(ts_us, 3),
+                    "pid": pid,
+                    "args": bf,
+                })
         elif r["kind"] == "memory":
             # memory-ledger samples: the instant event keeps the full
             # record browsable, the counter track plots the byte series

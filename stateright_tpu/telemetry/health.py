@@ -62,6 +62,11 @@ class HealthTracker:
         # vs the device budget; flagged once the table load is close
         # enough to the growth trigger that the migration is imminent
         self.oom_risk = False
+        # spill tier armed (docs/spill.md): the same forecast condition
+        # is INFORMATIONAL — the run will evict to the host tier at the
+        # boundary, not die — so it surfaces as ``spill_forecast``
+        # instead of ``growth_oom_risk`` (recorder.set_spill_armed)
+        self.spill_armed = False
         self._mem_next_transient: Optional[int] = None
         self._mem_budget: Optional[int] = None
         self._zero_novel = 0  # consecutive d_unique == 0 steps
@@ -165,12 +170,12 @@ class HealthTracker:
         events = []
         if oom != self.oom_risk:
             self.oom_risk = oom
-            events.append({
-                "event": (
-                    "growth_oom_risk" if oom else "growth_oom_risk_cleared"
-                ),
-                "phase": self.phase,
-            })
+            if self.spill_armed:
+                # informational: the next rung spills to the host tier
+                name = "spill_forecast" if oom else "spill_forecast_cleared"
+            else:
+                name = "growth_oom_risk" if oom else "growth_oom_risk_cleared"
+            events.append({"event": name, "phase": self.phase})
         if phase != self.phase:
             self.phase = phase
             events.append({"event": "phase", "phase": phase})
@@ -208,7 +213,11 @@ class HealthTracker:
             # the run, like an open stall
             self.oom_risk = False
             events.append({
-                "event": "growth_oom_risk_cleared", "phase": self.phase,
+                "event": (
+                    "spill_forecast_cleared" if self.spill_armed
+                    else "growth_oom_risk_cleared"
+                ),
+                "phase": self.phase,
             })
         if self.phase != "done":
             self.phase = "done"
@@ -258,7 +267,14 @@ class HealthTracker:
         return {
             "v": HEALTH_V,
             "phase": self.phase,
-            "oom_risk": self.oom_risk,
+            # the raw condition only reads as a RISK when no spill tier
+            # will catch the growth; armed, it is the spill forecast
+            "oom_risk": self.oom_risk and not self.spill_armed,
+            **(
+                {"spill_forecast": True}
+                if (self.oom_risk and self.spill_armed)
+                else {}
+            ),
             "stalled": self.stalled,
             **(
                 {"stall_reason": self.stall_reason}

@@ -123,21 +123,32 @@ _WAVEFRONT_NAMES = (
 def wavefront_specs(
     tensor, n_props: int, cap: int, qcap: int, batch: int,
     *, checked: bool = False, cartography: bool = False, por: bool = False,
+    spill=None,
 ) -> list:
     """Per-buffer specs of the single-device wavefront carry at these
     capacities — derived from the engine's own abstract carry signature
     (``wavefront._carry_avals``, the prewarm-AOT contract), so the
-    analytic bytes reconcile EXACTLY against the live buffers' nbytes."""
+    analytic bytes reconcile EXACTLY against the live buffers' nbytes.
+    ``spill`` is the spill-tier config ``(bloom_bits, pend_cap)`` when
+    the tier is armed: the Bloom filter and pending buffers are
+    device-resident and count against the budget like any carry buffer
+    (the HOST/DISK tier contents deliberately do not — they are what the
+    budget is being traded against)."""
     from ..parallel.wavefront import _carry_avals
 
     avals = _carry_avals(
-        tensor, n_props, cap, qcap, batch, checked, cartography, por
+        tensor, n_props, cap, qcap, batch, checked, cartography, por,
+        spill,
     )
     names = list(_WAVEFRONT_NAMES)
     if checked:
         names.append("checked_err")
     if por:
         names += ["por_boost", "por_stats"]
+    if spill:
+        names += ["spill_bloom", "spill_base", "pend_fp", "pend_rows",
+                  "pend_parent", "pend_ebits", "pend_depth", "pend_count",
+                  "spill_stats"]
     if cartography:
         names += ["cart_action_hist", "cart_prop_evals", "cart_prop_hits"]
     assert len(names) == len(avals), (len(names), len(avals))
@@ -295,7 +306,8 @@ def next_rung_block(spec_fn: Callable, caps: dict) -> dict:
 
 def capacity_plan(
     spec_fn: Callable, caps: dict, *, budget: Optional[int] = None,
-    rungs: int = 24,
+    rungs: int = 24, spill: bool = False,
+    spill_host_bytes: Optional[int] = None,
 ) -> dict:
     """The capacity ladder from ``caps`` upward: per rung, steady bytes,
     the migration transient (previous rung + this rung live), and —
@@ -303,7 +315,14 @@ def capacity_plan(
     planning headline: the largest rung whose TRANSIENT fits holds at
     most ``capacity / 4`` unique states before the next (unfitting)
     migration, i.e. "on this device the run reaches ~N states before
-    spilling"."""
+    spilling".
+
+    ``spill=True`` plans WITH the spill tier armed (docs/spill.md): the
+    ladder still caps the HOT tier at the largest affordable rung, but
+    ``max_unique`` no longer stops at HBM/4 — it extends by the host
+    tier's reach (``spill_host_bytes`` / ``STATERIGHT_TPU_HOST_BYTES`` /
+    half of physical RAM, at 16 bytes per spilled state) with the mmap'd
+    disk tier unbounded behind it, reported in the ``spill`` block."""
     ladder = []
     cur = dict(caps)
     prev_total = None
@@ -332,6 +351,24 @@ def capacity_plan(
     }
     if max_unique is not None:
         out["max_unique"] = max_unique
+    if spill and budget is not None and max_unique is not None:
+        from ..spill.store import BYTES_PER_ENTRY, default_host_budget
+
+        hb = (
+            int(spill_host_bytes)
+            if spill_host_bytes is not None
+            else default_host_budget()
+        )
+        block: dict = {
+            "hot_max_unique": max_unique,
+            "bytes_per_spilled": BYTES_PER_ENTRY,
+            "host_budget_bytes": hb,
+            "disk": "unbounded (mmap tier; bounded by disk capacity)",
+        }
+        if hb:
+            block["host_max_unique"] = hb // BYTES_PER_ENTRY
+            out["max_unique"] = max_unique + block["host_max_unique"]
+        out["spill"] = block
     return out
 
 
@@ -568,9 +605,11 @@ def snapshot_fits_guard(snap: dict, context: str) -> None:
         return
     total = snap.get("footprint_bytes")
     if total is None:
+        # HOT TIER ONLY: spill_* manifest arrays are host-resident tier
+        # contents (docs/spill.md) and never compete for device memory
         total = sum(
-            int(v.nbytes) for v in snap.values()
-            if isinstance(v, np.ndarray)
+            int(v.nbytes) for k, v in snap.items()
+            if isinstance(v, np.ndarray) and not str(k).startswith("spill_")
         )
     total = int(total)
     if total <= budget:

@@ -39,7 +39,7 @@ from .health import HealthTracker
 # their codes differently; the integers are never shared, only the names).
 STATUS_NAMES = frozenset({
     "ok", "queue_full", "table_full", "cand_full", "poison",
-    "frontier_full", "bucket_full",
+    "frontier_full", "bucket_full", "spill_sync",
 })
 
 
@@ -90,6 +90,10 @@ class FlightRecorder:
         # outside-the-ring discipline; setting it also arms the health
         # model's growth_oom_risk forecast
         self._memory: Optional[dict] = None
+        # latest spill-tier snapshot (stateright_tpu/spill/): same
+        # discipline again; the engines refresh it per eviction /
+        # resolution / sync
+        self._spill: Optional[dict] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -215,6 +219,25 @@ class FlightRecorder:
         with self._lock:
             return dict(self._memory) if self._memory else None
 
+    def set_spill(self, snap: dict) -> None:
+        """Replace the latest spill-tier snapshot (per-tier bytes, Bloom
+        load, deferral/resolution tallies — ``docs/spill.md``)."""
+        with self._lock:
+            self._spill = dict(snap)
+
+    def spill(self) -> Optional[dict]:
+        """Latest spill-tier snapshot, or None when the run was spawned
+        without ``CheckerBuilder.spill()``."""
+        with self._lock:
+            return dict(self._spill) if self._spill else None
+
+    def set_spill_armed(self, armed: bool = True) -> None:
+        """Tell the health model the spill tier is armed: the
+        ``growth_oom_risk`` condition downgrades to the informational
+        ``spill_forecast`` — the run will evict, not die."""
+        with self._lock:
+            self._health.spill_armed = bool(armed)
+
     def health(self) -> dict:
         """Live progress/health snapshot (health.py): phase, stall flag,
         novelty rate, EWMA throughput, drain ETA."""
@@ -334,6 +357,7 @@ class FlightRecorder:
                 dict(self._cartography) if self._cartography else None
             )
             memory = dict(self._memory) if self._memory else None
+            spill = dict(self._spill) if self._spill else None
         occ = [r for r in recs if r["kind"] == "occupancy"]
         out: dict = {
             **meta,
@@ -370,6 +394,8 @@ class FlightRecorder:
             out["cartography"] = cartography
         if memory is not None:
             out["memory"] = memory
+        if spill is not None:
+            out["spill"] = spill
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")
@@ -399,6 +425,8 @@ class FlightRecorder:
                 self._cartography = dict(summary["cartography"])
             if summary.get("memory") and self._memory is None:
                 self._memory = dict(summary["memory"])
+            if summary.get("spill") and self._spill is None:
+                self._spill = dict(summary["spill"])
             if summary.get("states") is not None and self._last_step:
                 last_t = self._last_step[0]
                 self._last_step = (

@@ -390,7 +390,7 @@ def test_explorer_metrics_endpoint_shape():
         m = _get(server.addr, "/.metrics")
         assert sorted(m) == [
             "cartography", "counters", "health", "memory", "occupancy",
-            "series", "summary",
+            "series", "spill", "summary",
         ]
         series = m["series"]
         assert sorted(series) == [

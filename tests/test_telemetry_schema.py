@@ -81,6 +81,18 @@ SCHEMA = {
         {"shard_load": list, "shard_imbalance": dict,
          "route_matrix": list, "routed_candidates": int},
     ),
+    "spill": (
+        # spill-tier events (stateright_tpu/spill/, docs/spill.md):
+        # arm (run start), evict (hot table -> host tier), resolve
+        # (pending vs the host index), queue_offload/queue_refill
+        # (budget-blocked queue doubling), final
+        {"v": int, "event": str},
+        {"bloom_bits": int, "pend_cap": int, "budget_bytes": int,
+         "evicted": int, "spilled_fps": int, "host_bytes": int,
+         "disk_bytes": int, "bloom_est_false_pos": _REAL,
+         "pending": int, "dups": int, "novel": int,
+         "rows": int, "host_rows": int},
+    ),
     "memory": (
         # the HBM ledger's per-rung snapshot (telemetry/memory.py):
         # per-buffer analytic bytes + the growth-transient forecast;
@@ -177,6 +189,49 @@ def test_every_exported_record_matches_the_golden_schema(tmp_path):
     for r in records:
         problems += _check_record(r)
     assert not problems, "\n".join(problems)
+
+
+def test_spill_records_match_the_golden_schema(tmp_path, monkeypatch):
+    """A run under a simulated budget that forces eviction emits the
+    versioned ``spill`` record kind (arm/evict/resolve/final), every
+    record validated field-by-field like the rest of the export."""
+    from stateright_tpu.parallel.tensor_model import twin_or_none
+    from stateright_tpu.telemetry.memory import (
+        ENV_DEVICE_BYTES,
+        total_bytes,
+        wavefront_specs,
+    )
+
+    m = TwoPhaseSys(5)
+    twin = twin_or_none(m)
+    n_props = len(list(m.properties()))
+    batch, bloom, qcap = 128, 1 << 14, 4096
+    sp = (bloom, batch * twin.max_actions)
+
+    def tot(cap):
+        return total_bytes(
+            wavefront_specs(twin, n_props, cap, qcap, batch, spill=sp)
+        )
+
+    monkeypatch.setenv(ENV_DEVICE_BYTES, str(tot(1 << 13) + tot(1 << 14) - 1))
+    monkeypatch.setenv("STATERIGHT_TPU_CAPACITY_GUARD", "off")
+    lines = _export_lines(
+        tmp_path,
+        TwoPhaseSys(5).checker().spill().telemetry(),
+        capacity=1 << 12, batch=batch, queue_capacity=qcap,
+        spill_bloom_bits=bloom, steps_per_call=8,
+    )
+    records = [ln for ln in lines if ln.get("kind") != "header"]
+    spills = [r for r in records if r["kind"] == "spill"]
+    events = {r["event"] for r in spills}
+    for expect in ("arm", "evict", "resolve", "final"):
+        assert expect in events, f"run did not emit a spill {expect!r} event"
+    problems = []
+    for r in records:
+        problems += _check_record(r)
+    assert not problems, "\n".join(problems)
+    # the summary carries the live spill block alongside memory/cartography
+    assert lines[0]["summary"]["spill"]["spilled_fps"] > 0
 
 
 def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
