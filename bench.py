@@ -727,6 +727,51 @@ def tpu_phase() -> dict:
             out["tpu_paxos3_por_error"] = f"{type(e).__name__}: {e}"
         _persist(out)
 
+        # per-channel leg (same BENCH_POR flag): the encoding where POR
+        # actually reduces (docs/analysis.md "Per-channel encoding").
+        # paxos-2 is the largest bundled paxos the MECHANICAL compiler
+        # covers — the 3-client closure exceeds the per-actor universe
+        # cap — so the reduction keys measure the full paxos-2 space:
+        # per-channel full expansion vs per-channel + por(), with
+        # reduction_ratio = explored/full unique and verdict parity
+        # asserted so a broken reduction can't report a win.
+        try:
+            _mark("compile (paxos2 per-channel engines)")
+            pc_caps = dict(sync=True, capacity=1 << 16, batch=512)
+            m2f = paxos_model(2, 3)
+            m2f.per_channel_()
+            tpu_pcf, dt_pcf = timed(
+                lambda: m2f.checker().spawn_tpu(**pc_caps)
+            )
+            m2p = paxos_model(2, 3)
+            m2p.per_channel_()
+            tpu_pc, dt_pc = timed(
+                lambda: m2p.checker().por().spawn_tpu(**pc_caps)
+            )
+            if sorted(tpu_pc.discoveries()) != sorted(tpu_pcf.discoveries()):
+                raise AssertionError(
+                    "per-channel por changed property discoveries: "
+                    f"{sorted(tpu_pc.discoveries())} != "
+                    f"{sorted(tpu_pcf.discoveries())}"
+                )
+            full_u = tpu_pcf.unique_state_count()
+            por_u = tpu_pc.unique_state_count()
+            out["tpu_paxos2_por_channel_states_per_sec"] = round(
+                tpu_pc.state_count() / dt_pc, 1
+            )
+            out["tpu_paxos2_por_channel_unique"] = por_u
+            out["tpu_paxos2_por_channel_full_unique"] = full_u
+            out["tpu_paxos2_por_channel_sec"] = round(dt_pc, 3)
+            out["tpu_paxos2_por_channel_full_sec"] = round(dt_pcf, 3)
+            out["tpu_paxos2_por_channel_reduction_ratio"] = round(
+                por_u / full_u, 4
+            ) if full_u else None
+            out["tpu_paxos2_por_channel"] = tpu_pc.por_status()
+            _mark("paxos2 per-channel por leg done")
+        except Exception as e:  # noqa: BLE001 - same never-void rule
+            out["tpu_paxos2_por_channel_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
     # remaining parity gate + the driver metric's second config, 2pc check 4
     # AS WRITTEN (it is too small to rate-limit a TPU — ~2k unique states
     # finish in one engine call — so the rate mostly measures fixed per-run

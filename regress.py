@@ -119,13 +119,16 @@ def independence_verdict(run: dict, fleet=None) -> dict:
 
     Runs the fleet independence gate (every bundled example must produce
     a well-formed conflict matrix with no ERROR-level finding — the same
-    contract as the CI verb), and, when the run artifact carries a
-    flag-gated POR leg (``tpu_paxos3_por``), checks it is well-formed: a
-    dict with an ``enabled`` bool, plus matching unique counts when both
-    legs ran (POR must never change counts on paxos — its matrix is
-    conservatively all-dependent).  Stale/pre-POR baselines never gate
-    (the ``--sanitize``/``--cartography`` rule); ``fleet`` overrides the
-    runner for tests."""
+    contract as the CI verb), and, when the run artifact carries the
+    flag-gated POR legs, checks them: ``tpu_paxos3_por`` must be a
+    well-formed dict with an ``enabled`` bool plus matching unique
+    counts when both legs ran (the slot-multiset paxos twin must never
+    reduce — all-dependent matrix), and ``tpu_paxos2_por_channel`` (the
+    per-channel reduction leg) must carry ``encoding == "per-channel"``
+    and a ``reduction_ratio`` in ``(0, 1]`` consistent with its
+    unique/full_unique counts.  Stale/pre-POR/pre-channel baselines
+    never gate (the ``--sanitize``/``--cartography`` rule); ``fleet``
+    overrides the runner for tests."""
     import io
 
     if fleet is None:
@@ -164,6 +167,51 @@ def independence_verdict(run: dict, fleet=None) -> dict:
         if problems:
             out["clean"] = False
             out["por_leg"]["problems"] = problems
+    # the per-channel reduction leg (BENCH_POR=1; bench.py): well-formed
+    # block + ratio sanity.  Stale/pre-channel artifacts carry neither
+    # the block nor the error key and never trip; a crashed leg fails.
+    ch_error = run.get("tpu_paxos2_por_channel_error")
+    if ch_error:
+        out["clean"] = False
+        out["por_channel_leg"] = {
+            "ok": False, "problems": [f"leg crashed: {ch_error}"],
+        }
+        return out
+    ch = run.get("tpu_paxos2_por_channel")
+    if ch is not None:
+        problems = []
+        if not isinstance(ch, dict) or "enabled" not in ch:
+            problems.append("tpu_paxos2_por_channel block malformed")
+        elif ch.get("encoding") != "per-channel":
+            problems.append(
+                f"per-channel leg ran encoding {ch.get('encoding')!r}"
+            )
+        u_por = run.get("tpu_paxos2_por_channel_unique")
+        u_full = run.get("tpu_paxos2_por_channel_full_unique")
+        ratio = run.get("tpu_paxos2_por_channel_reduction_ratio")
+        if not (isinstance(u_por, int) and isinstance(u_full, int)
+                and u_full > 0):
+            problems.append("per-channel unique/full_unique missing")
+        else:
+            if u_por > u_full:
+                problems.append(
+                    f"reduced unique {u_por} EXCEEDS full {u_full} — a "
+                    "reduction can only shrink the explored space"
+                )
+            if not (
+                isinstance(ratio, (int, float)) and 0 < ratio <= 1
+                and abs(ratio - u_por / u_full) < 1e-3
+            ):
+                problems.append(
+                    f"reduction_ratio {ratio!r} out of (0, 1] or "
+                    f"inconsistent with {u_por}/{u_full}"
+                )
+        out["por_channel_leg"] = {"ok": not problems}
+        if ratio is not None:
+            out["por_channel_leg"]["reduction_ratio"] = ratio
+        if problems:
+            out["clean"] = False
+            out["por_channel_leg"]["problems"] = problems
     return out
 
 

@@ -146,6 +146,10 @@ class ActorModel(Model):
         self.init_history = init_history
         self.init_network: Network = Network.new_unordered_duplicating()
         self.lossy: bool = False
+        # device-twin network packing (parallel/actor_compiler.py): None =
+        # unset (the STATERIGHT_TPU_PER_CHANNEL env knob decides), else the
+        # per_channel_() builder's explicit choice
+        self.per_channel: Optional[bool] = None
         self._properties: list[Property] = []
         self._record_msg_in: Callable = lambda cfg, h, env: None
         self._record_msg_out: Callable = lambda cfg, h, env: None
@@ -172,6 +176,39 @@ class ActorModel(Model):
         self._config_mutated()
         self.lossy = lossy
         return self
+
+    def per_channel_(self, enabled: bool = True) -> "ActorModel":
+        """Request the per-(src,dst)-channel network packing for the
+        compiled device twin (``parallel/actor_compiler.py``): the row
+        reserves one slot region per directed channel instead of one
+        global slot multiset, which makes a delivery's writes statically
+        confined — the independence analysis can then decompose the
+        action stack (no ``JX302``) and ``por()`` produces real reduction
+        on consensus-shaped workloads (``docs/analysis.md``
+        "Per-channel encoding").  Changes row fingerprints (an encoding
+        choice, like the twin itself); unique/total counts and property
+        verdicts are bit-identical to the slot-multiset packing, pinned.
+        One capacity caveat: an ORDERED flow holding the same message at
+        more ranks than its channel's distinct-code count poisons loudly
+        (never silently diverges) — raise the region size with
+        ``compile_actor_model(per_channel_depth=...)`` for retransmitting
+        protocols.  CLI flag: ``--per-channel`` on the device verbs; env
+        knob: ``STATERIGHT_TPU_PER_CHANNEL=1``."""
+        self._config_mutated()
+        self.per_channel = bool(enabled)
+        return self
+
+    def per_channel_resolved(self) -> bool:
+        """The effective per-channel choice: the builder flag when set,
+        else the ``STATERIGHT_TPU_PER_CHANNEL=1`` env knob — the ONE
+        resolution rule, shared by the compiler and by ``tensor_model``
+        implementations that must route between a hand-tuned slot-multiset
+        twin and the compiled per-channel one (``models/paxos.py``)."""
+        if self.per_channel is not None:
+            return bool(self.per_channel)
+        import os
+
+        return os.environ.get("STATERIGHT_TPU_PER_CHANNEL", "") == "1"
 
     def property(
         self, expectation: Expectation, name: str, condition: Callable

@@ -37,6 +37,14 @@ class Envelope:
     dst: Any  # Id
     msg: Any
 
+    @property
+    def channel(self) -> Tuple[int, int]:
+        """The directed ``(src, dst)`` channel this envelope travels on —
+        the unit of the per-channel device packing
+        (``parallel/actor_compiler.py``) and, for ordered networks, the
+        FIFO flow key."""
+        return (int(self.src), int(self.dst))
+
     def __repr__(self):
         return f"Envelope(src={self.src!r}, dst={self.dst!r}, msg={self.msg!r})"
 
@@ -104,6 +112,15 @@ class Network:
     def iter_all(self) -> Iterator[Envelope]:
         """Every envelope, with multiplicity."""
         raise NotImplementedError
+
+    def channels(self) -> list:
+        """Sorted directed ``(src, dst)`` channels currently carrying
+        traffic.  All three semantics share the definition: the channel
+        partition of the in-flight set — for ordered networks the
+        channels ARE the FIFO flows; for the unordered semantics they are
+        the per-destination confinement the per-channel device packing
+        exploits (``parallel/actor_compiler.py``)."""
+        return sorted({env.channel for env in self.iter_all()})
 
     def __len__(self) -> int:
         raise NotImplementedError

@@ -141,6 +141,14 @@ class Info:
     ``input[word] >> shift`` (meaningful only with provenance).
     ``supp`` — value-position bits that can be non-zero (None = all).
     ``const`` — exact value when statically known (scalar constants).
+    ``acc`` — value-position bits where the value is an OR-ACCUMULATE of
+    the identity content: ``(input[word] >> shift) | f(deps)``
+    (meaningful only with provenance; always disjoint from ``eq``).
+    Written back to its own word, such a bit is a *monotone* write — two
+    actions' accumulates commute bit-for-bit, which is what lets the
+    compiled twins' saturating poison flag stay out of the conflict
+    relation (``independence.py``; the per-channel kernel's
+    ``_or_field`` idiom).
     """
 
     deps: FieldSet = field(default_factory=FieldSet.empty)
@@ -149,6 +157,7 @@ class Info:
     eq: int = 0
     supp: Optional[int] = None
     const: Optional[int] = None
+    acc: int = 0
 
     def as_data(self) -> FieldSet:
         """Full read set when the value is consumed AS DATA (identity
@@ -165,14 +174,18 @@ TOP_INFO = Info(deps=FieldSet.top_set())
 
 def _join(a: Info, b: Info) -> Info:
     """Join two infos (select/concat): identity survives only where both
-    sides carry it, on the intersection of their eq bits."""
+    sides carry it, on the intersection of their eq bits.  A bit stays an
+    OR-accumulate when BOTH branches keep it ``old | something`` (eq or
+    acc) — ``select(p, old, old | f)`` is still ``old | (p ? f : 0)``."""
     deps = a.deps.union(b.deps)
     if (a.word is not None and a.word == b.word and a.shift == b.shift):
         supp = None if (a.supp is None or b.supp is None) else (
             a.supp | b.supp
         )
+        eq = a.eq & b.eq
+        safe = (a.eq | a.acc) & (b.eq | b.acc)
         return Info(deps=deps, word=a.word, shift=a.shift,
-                    eq=a.eq & b.eq, supp=supp)
+                    eq=eq, supp=supp, acc=safe & ~eq)
     return Info(deps=a.as_data().union(b.as_data()))
 
 
@@ -249,12 +262,17 @@ class ActionFootprint:
     writes: FieldSet  # row bits the successor may change
     guard: FieldSet  # enabledness-condition reads
     decided: bool  # False when any component collapsed to TOP
+    # monotone OR-accumulate writes (``new = old | f(reads)``): commute
+    # with each other, conflict with plain writes and with reads of the
+    # same bits — the compiled twins' saturating poison flag
+    accum: FieldSet = field(default_factory=FieldSet.empty)
 
     def to_json(self) -> dict:
         return {
             "reads": self.reads.to_json(),
             "writes": self.writes.to_json(),
             "guard": self.guard.to_json(),
+            "accum": self.accum.to_json(),
             "decided": self.decided,
         }
 
@@ -268,10 +286,13 @@ class ConjunctInfo:
 
     ``sets[a]`` — one FieldSet per conjunct of action ``a`` (≥ 1; the
     fallback is the whole guard as a single conjunct).
-    ``leaf_idx[a]`` — indices of ``a``'s conjuncts into the kernel's leaf
-    outputs, or None: the single-conjunct fallback, whose truth is the
-    action's enabled bit itself (a disabled action's whole guard is false
-    by definition — no kernel evaluation needed).
+    ``leaf_idx[a]`` — ``(leaf, lane)`` references of ``a``'s conjuncts
+    into the kernel's leaf outputs (``lane`` is None for a scalar ``[B]``
+    leaf, else the action's lane within a ``[B, cap]`` guard BLOCK — the
+    per-channel kernel stacks one guard array per channel, and lane ``k``
+    is slot ``k``'s truth), or None: the single-conjunct fallback, whose
+    truth is the action's enabled bit itself (a disabled action's whole
+    guard is false by definition — no kernel evaluation needed).
     ``n_leaves`` — total distinct evaluable conjunct leaves.
     """
 
@@ -470,9 +491,20 @@ def _rule_and_info(a: Info, b: Info) -> Info:
             continue
         supp = (ALL64 if x.supp is None else x.supp) & c
         if x.word is not None:
-            return replace(x, eq=x.eq & c, supp=supp, const=None)
+            # AND-with-const zeroes bits outside c: an accumulate bit
+            # masked off is a plain write again, not ``old | f``
+            return replace(x, eq=x.eq & c, acc=x.acc & c, supp=supp,
+                           const=None)
         return Info(deps=x.deps, supp=supp)
-    return _data_combine(a, b)
+    out = _data_combine(a, b)
+    if a.supp is not None or b.supp is not None:
+        # no identity survives, but the support still intersects: an AND
+        # can only keep bits both operands can carry (what keeps a
+        # boolean flag's support at bit 0 through ``occ & poisoned``)
+        sa = ALL64 if a.supp is None else a.supp
+        sb = ALL64 if b.supp is None else b.supp
+        out = replace(out, supp=sa & sb)
+    return out
 
 
 def _rule_or_info(a: Info, b: Info) -> Info:
@@ -483,12 +515,16 @@ def _rule_or_info(a: Info, b: Info) -> Info:
         if x.word is not None and y.word is None and y.supp is not None:
             # value | bounded-support operand: only the operand's support
             # bits stop equalling the input word (the pk.set idiom:
-            # cleared | (v & mask) — v's support is the field mask)
+            # cleared | (v & mask) — v's support is the field mask).
+            # Those bits become ``old | f`` — OR-accumulates, provided
+            # they were still safe (eq or already-acc) before
+            eq = x.eq & ~y.supp
             return Info(
                 deps=x.deps.union(y.deps),
                 word=x.word, shift=x.shift,
-                eq=x.eq & ~y.supp,
+                eq=eq,
                 supp=None if x.supp is None else (x.supp | y.supp),
+                acc=x.acc | (x.eq & y.supp),
             )
     if (a.word is not None and a.word == b.word and a.shift == b.shift):
         sa = ALL64 if a.supp is None else a.supp
@@ -501,7 +537,10 @@ def _rule_or_info(a: Info, b: Info) -> Info:
             deps = deps.union(FieldSet.of(a.word, leak & ALL64))
         return Info(deps=deps, word=a.word, shift=a.shift, eq=eq,
                     supp=sa | sb)
-    return _data_combine(a, b)
+    out = _data_combine(a, b)
+    if a.supp is not None and b.supp is not None:
+        out = replace(out, supp=a.supp | b.supp)
+    return out
 
 
 def _rule_xor_info(a: Info, b: Info) -> Info:
@@ -511,13 +550,18 @@ def _rule_xor_info(a: Info, b: Info) -> Info:
     for x, y in ((a, b), (b, a)):
         if x.word is not None and y.word is None and y.supp is not None:
             # value ^ bounded-support operand: only the support bits flip
+            # (a flipped bit is NOT an OR-accumulate — not monotone)
             return Info(
                 deps=x.deps.union(y.deps),
                 word=x.word, shift=x.shift,
                 eq=x.eq & ~y.supp,
                 supp=None if x.supp is None else (x.supp | y.supp),
+                acc=x.acc & ~y.supp,
             )
-    return _data_combine(a, b)
+    out = _data_combine(a, b)
+    if a.supp is not None and b.supp is not None:
+        out = replace(out, supp=a.supp | b.supp)
+    return out
 
 
 def _rule_shift_info(left: bool):
@@ -536,12 +580,13 @@ def _rule_shift_info(left: bool):
         if left:
             if k <= a.shift:
                 return Info(deps=a.deps, word=a.word, shift=a.shift - k,
-                            eq=(a.eq << k) & ALL64, supp=(supp << k) & ALL64)
+                            eq=(a.eq << k) & ALL64, supp=(supp << k) & ALL64,
+                            acc=(a.acc << k) & ALL64)
             # over-shift past the origin: identity content moves to higher
             # input positions than it came from — fold to data
             return Info(deps=a.as_data(), supp=(supp << k) & ALL64)
         return Info(deps=a.deps, word=a.word, shift=a.shift + k,
-                    eq=a.eq >> k, supp=supp >> k)
+                    eq=a.eq >> k, supp=supp >> k, acc=a.acc >> k)
 
     return rule
 
@@ -713,6 +758,23 @@ def _rule_scatter(itp: _FpInterp, eqn, ins):
     return _scalar(Info(deps=operand.collapse().as_data().union(upd)))
 
 
+def _rule_gather(itp, eqn, ins):
+    """Table lookups (``table[idx]``): the output's support is bounded by
+    the TABLE's support — every gathered element is one of its entries.
+    An all-zero table (a factored predicate that is constant-False for
+    this actor) therefore yields a CONSTANT zero with no reads at all,
+    which is what lets ``exists_actor(lambda i, s: i == K and ...)``
+    read only actor K's field instead of every actor's."""
+    operand = ins[0].collapse()
+    idx = ins[1].collapse() if len(ins) > 1 else TOP_INFO
+    if (operand.supp == 0 and operand.deps.is_empty):
+        return _scalar(Info(supp=0, const=0))
+    return _scalar(Info(
+        deps=operand.as_data().union(idx.as_data()),
+        supp=operand.supp,
+    ))
+
+
 def _rule_reduce(itp, eqn, ins):
     return _scalar(Info(deps=ins[0].collapse().as_data()))
 
@@ -751,6 +813,7 @@ _FP_RULES = {
     "copy": _rule_convert,
     "stop_gradient": _rule_convert,
     "concatenate": _rule_concat,
+    "gather": _rule_gather,
     "scatter": _rule_scatter,
     "transpose": _rule_transpose,
     "reduce_sum": _rule_reduce, "reduce_max": _rule_reduce,
@@ -793,13 +856,18 @@ def _flatten_stack(itp: _FpInterp, var, axis: int, depth: int = 6) -> list:
 def _action_footprint_from_lanes(lanes, guard: FieldSet) -> ActionFootprint:
     """Writes/reads of one action's successor row from its lane infos."""
     writes = FieldSet.empty()
+    accum = FieldSet.empty()
     reads = FieldSet.empty()
     decided = not guard.top
     for w, info in enumerate(lanes):
         if info.word == w and info.shift == 0:
             dirty = (~info.eq) & ALL64
-            if dirty:
-                writes = writes.union(FieldSet.of(w, dirty))
+            accb = info.acc & dirty
+            plain = dirty & ~accb
+            if plain:
+                writes = writes.union(FieldSet.of(w, plain))
+            if accb:
+                accum = accum.union(FieldSet.of(w, accb))
             reads = reads.union(info.deps)
             if info.deps.top:
                 decided = False
@@ -813,7 +881,7 @@ def _action_footprint_from_lanes(lanes, guard: FieldSet) -> ActionFootprint:
     if writes.top or reads.top:
         decided = False
     return ActionFootprint(reads=reads, writes=writes, guard=guard,
-                           decided=decided)
+                           decided=decided, accum=accum)
 
 
 def _trace(fn, avals):
@@ -893,14 +961,24 @@ def _and_leaves(producers_tl: dict, var, depth: int = 16) -> Optional[list]:
 
 
 def _guard_vars(closed, producers_tl: dict, arity: int) -> Optional[list]:
-    """Per-action guard bool vars from the ``valid`` output's action-axis
-    stack (top-level walk); None when it does not decompose."""
+    """Per-action ``(guard var, lane)`` pairs from the ``valid`` output's
+    action-axis stack (top-level walk); None when it does not decompose.
+    A ``[B, cap]`` stack piece covers ``cap`` consecutive actions (the
+    per-channel kernel's one-guard-array-per-channel idiom): each gets
+    the same var with its lane index within the run."""
     vout = closed.jaxpr.outvars[1]
     ndim = len(_shape(vout))
     pieces = _flatten_stack_tl(producers_tl, vout, ndim - 1)
     if pieces is None or len(pieces) != arity:
         return None
-    return [_walk_tl(producers_tl, p) for p in pieces]
+    out = []
+    prev, lane = None, 0
+    for p in pieces:
+        v = _walk_tl(producers_tl, p)
+        lane = lane + 1 if (prev is not None and v is prev) else 0
+        prev = v
+        out.append((v, lane))
+    return out
 
 
 def _conjunct_info(itp: _FpInterp, closed, arity: int,
@@ -911,11 +989,20 @@ def _conjunct_info(itp: _FpInterp, closed, arity: int,
     divergence between two copies of the walk would silently demote
     every run to the imprecise fallback), plus the per-leaf read
     footprints; whole-guard single-conjunct fallback where no and-tree
-    extracts."""
+    extracts.  A laned reference reads the LANE's footprint when the
+    leaf is tracked — slot ``k``'s occupancy conjunct reads one region
+    word, not the whole region."""
+
+    def conjunct_set(i, ln):
+        av = itp.read(leaves[i])
+        if ln is not None and av.tracked and ln < len(av.lanes):
+            return av.lanes[ln].as_data()
+        return av.collapse().as_data()
+
     leaves, leaf_idx = _leaf_vars_of(closed, arity)
     sets = [
         [guards[a]] if idx is None
-        else [itp.read(leaves[i]).collapse().as_data() for i in idx]
+        else [conjunct_set(i, ln) for (i, ln) in idx]
         for a, idx in enumerate(leaf_idx)
     ]
     return ConjunctInfo(sets=sets, leaf_idx=leaf_idx,
@@ -923,19 +1010,23 @@ def _conjunct_info(itp: _FpInterp, closed, arity: int,
 
 
 def _leaf_vars_of(closed, arity: int) -> tuple:
-    """(ordered leaf vars, per-action leaf indices) for kernel building —
-    re-derivable at any batch size; the derivation is deterministic for a
-    deterministic trace (the JX104 retrace-stability contract)."""
+    """(ordered leaf vars, per-action ``(leaf, lane)`` indices) for
+    kernel building — re-derivable at any batch size; the derivation is
+    deterministic for a deterministic trace (the JX104 retrace-stability
+    contract).  A ``[B, cap]`` leaf (the per-channel guard-block idiom)
+    carries one lane per action of its block; a ``[B]`` leaf applies to
+    the whole block (lane None)."""
     producers_tl = producers_of(closed.jaxpr)
     gvars = _guard_vars(closed, producers_tl, arity)
     leaves: list = []
     leaf_pos: dict = {}
     idx: list = []
     for a in range(arity):
-        if gvars is None or is_literal(gvars[a]):
+        if gvars is None or is_literal(gvars[a][0]):
             idx.append(None)
             continue
-        lv = _and_leaves(producers_tl, gvars[a])
+        gv, lane = gvars[a]
+        lv = _and_leaves(producers_tl, gv)
         if not lv or len(lv) > _MAX_CONJUNCTS or any(
             is_literal(v) for v in lv
         ):
@@ -943,22 +1034,31 @@ def _leaf_vars_of(closed, arity: int) -> tuple:
             continue
         cidx = []
         for v in lv:
+            sh = _shape(v)
+            if len(sh) == 1:
+                ln = None
+            elif len(sh) == 2 and lane < sh[-1]:
+                ln = lane
+            else:  # a shape the kernel cannot index per action
+                cidx = None
+                break
             if v not in leaf_pos:
                 leaf_pos[v] = len(leaves)
                 leaves.append(v)
-            cidx.append(leaf_pos[v])
+            cidx.append((leaf_pos[v], ln))
         idx.append(cidx)
     return leaves, idx
 
 
 def conjunct_eval_fn(tensor):
     """A batch-size-polymorphic evaluator of the guard-conjunct leaves:
-    ``fn(rows[B, W]) -> bool[B, n_leaves]`` (or None when the model has no
-    evaluable leaves).  The step kernel is re-traced per batch size and
-    the leaf outputs are exposed as jaxpr outputs; under ``jit`` XLA
-    dead-code-eliminates the successor computation, so the evaluation
-    costs only the guard bit-ops themselves.  Cached per batch size on
-    the twin."""
+    ``fn(rows[B, W]) -> [bool[B] | bool[B, cap], ...]`` — the raw leaf
+    arrays, indexed by the plan's ``(leaf, lane)`` conjunct references —
+    or None when the model has no evaluable leaves.  The step kernel is
+    re-traced per batch size and the leaf outputs are exposed as jaxpr
+    outputs; under ``jit`` XLA dead-code-eliminates the successor
+    computation, so the evaluation costs only the guard bit-ops
+    themselves.  Cached per batch size on the twin."""
     import jax
     import jax.numpy as jnp
 
@@ -1004,8 +1104,7 @@ def conjunct_eval_fn(tensor):
             cache[b] = built
         if built is False:
             return None
-        outs = built(rows)
-        return jnp.stack(list(outs), axis=-1)
+        return list(built(rows))
 
     return fn
 

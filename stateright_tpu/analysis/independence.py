@@ -33,6 +33,10 @@ Rule catalogue (``JX3xx``, ``docs/analysis.md``):
  - ``JX304`` info — ``por()`` would fall back to full expansion for this
    model (an ``eventually`` property makes reduction unsound, or the
    matrix admits no independent pair).
+ - ``JX305`` info — the non-decomposition is specifically the
+   slot-multiset actor-network packing: names the per-channel encoding
+   escape hatch (``ActorModel.per_channel_()`` / ``--per-channel`` /
+   ``STATERIGHT_TPU_PER_CHANNEL=1``) that makes the stack decompose.
 """
 
 from __future__ import annotations
@@ -64,6 +68,9 @@ class IndependenceReport:
     visible: np.ndarray  # bool [A]: writes intersect any property read
     footprints: Optional[ModelFootprints]
     findings: list = field(default_factory=list)
+    #: network packing of the analyzed twin ("slot-multiset" /
+    #: "per-channel" for compiled actor twins, None for hand-written ones)
+    encoding: Optional[str] = None
 
     @property
     def independent_pairs(self) -> int:
@@ -81,6 +88,7 @@ class IndependenceReport:
                 else self.n_actions
             ),
             "decomposed": bool(fp.decomposed) if fp is not None else False,
+            "encoding": self.encoding,
             "rules": sorted({f.rule_id for f in self.findings}),
         }
 
@@ -111,15 +119,30 @@ class PorPlan:
 
 def _conflicts(fa, fb) -> bool:
     """May ``a`` and ``b`` interfere?  Independence needs BOTH directions
-    write-vs-(read ∪ write ∪ guard) disjoint; undecided is dependent."""
+    write-vs-(read ∪ write ∪ guard) disjoint; undecided is dependent.
+
+    ``accum`` bits (monotone OR-accumulates, ``new = old | f(reads)`` —
+    the compiled twins' saturating poison flag) get ONE exemption:
+    accum∩accum is commutative bit-for-bit (``old | fa | fb`` either
+    way, and each side's ``f`` reads only its own footprint, which the
+    plain rules already keep disjoint), so two accumulating actions stay
+    independent.  Against everything else an accum bit behaves exactly
+    like a write: a plain write could clobber the accumulated bit, and a
+    read/guard of it would observe order."""
     if not fa.decided or not fb.decided:
         return True
     return (
         fa.writes.intersects(fb.reads)
         or fa.writes.intersects(fb.writes)
         or fa.writes.intersects(fb.guard)
+        or fa.writes.intersects(fb.accum)
         or fb.writes.intersects(fa.reads)
         or fb.writes.intersects(fa.guard)
+        or fb.writes.intersects(fa.accum)
+        or fa.accum.intersects(fb.reads)
+        or fa.accum.intersects(fb.guard)
+        or fb.accum.intersects(fa.reads)
+        or fb.accum.intersects(fa.guard)
     )
 
 
@@ -131,6 +154,7 @@ def run_independence(tensor, props, model_name: str = "") -> IndependenceReport:
     if cached is not None:
         return cached
     arity = int(getattr(tensor, "max_actions", 0) or 0)
+    encoding = getattr(tensor, "network_encoding", None)
     fps = extract_footprints(tensor)
     findings: list = []
     if fps is None:
@@ -141,7 +165,8 @@ def run_independence(tensor, props, model_name: str = "") -> IndependenceReport:
             "no footprints (kernel untraceable or twin contract missing): "
             "every action pair is conservatively dependent",
         ))
-        out = IndependenceReport(arity, conflict, visible, None, findings)
+        out = IndependenceReport(arity, conflict, visible, None, findings,
+                                 encoding=encoding)
         _cache(tensor, out)
         return out
 
@@ -157,7 +182,9 @@ def run_independence(tensor, props, model_name: str = "") -> IndependenceReport:
         FieldSet.top_set()
     )
     visible = np.asarray([
-        (not a.decided) or a.writes.intersects(prop_union)
+        (not a.decided)
+        or a.writes.intersects(prop_union)
+        or a.accum.intersects(prop_union)
         for a in fps.actions
     ], bool)
 
@@ -169,6 +196,21 @@ def run_independence(tensor, props, model_name: str = "") -> IndependenceReport:
             "conflict matrix is conservatively all-dependent; por() runs "
             "as full expansion",
         ))
+        # JX305 — the actionable escape hatch: when the non-decomposition
+        # is the slot-multiset actor packing specifically, the fix is one
+        # builder/CLI flag away (pinned firing on the default paxos twin,
+        # silent once the model migrates to per-channel)
+        if getattr(tensor, "network_encoding", None) == "slot-multiset":
+            findings.append(AuditFinding(
+                "JX305", Severity.INFO, "step_rows",
+                "this is the slot-multiset network packing: a delivery's "
+                "destination is message DATA, so its writes cannot be "
+                "statically confined.  Re-compile with the per-channel "
+                "layout — ActorModel.per_channel_() / --per-channel / "
+                "STATERIGHT_TPU_PER_CHANNEL=1 — to make the action stack "
+                "decompose and turn por() into real reduction "
+                "(docs/analysis.md \"Per-channel encoding\")",
+            ))
     else:
         und = fps.undecided_actions
         for a in und[:_MAX_LISTED]:
@@ -189,7 +231,9 @@ def run_independence(tensor, props, model_name: str = "") -> IndependenceReport:
     # write anything, so the lint stays silent (no false fleet noise).
     all_writes_decided = all(a.decided for a in fps.actions)
     if all_writes_decided and props and fps.prop_reads:
-        writes_union = union_all(a.writes for a in fps.actions)
+        writes_union = union_all(
+            a.writes.union(a.accum) for a in fps.actions
+        )
         for p, reads in zip(props, fps.prop_reads):
             if reads.top or reads.is_empty:
                 continue
@@ -202,7 +246,8 @@ def run_independence(tensor, props, model_name: str = "") -> IndependenceReport:
                     "states — a dead/vacuous (likely miswired) property",
                 ))
 
-    out = IndependenceReport(arity, conflict, visible, fps, findings)
+    out = IndependenceReport(arity, conflict, visible, fps, findings,
+                             encoding=encoding)
 
     # JX304 — por() fallback preview for this model
     plan = _plan_from(out, props, tensor)
@@ -265,7 +310,11 @@ def _plan_from(report: IndependenceReport, props, tensor=None) -> PorPlan:
         for ki, cset in enumerate(cj.sets[i]):
             for j in range(a):
                 fj = fps.actions[j]
-                en[i, ki, j] = (not fj.decided) or fj.writes.intersects(cset)
+                en[i, ki, j] = (
+                    (not fj.decided)
+                    or fj.writes.intersects(cset)
+                    or fj.accum.intersects(cset)
+                )
     return PorPlan(
         report.conflict, report.visible, True,
         enablers=en, leaf_idx=list(cj.leaf_idx), n_leaves=cj.n_leaves,

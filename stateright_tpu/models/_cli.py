@@ -79,7 +79,8 @@ def run_cli(
         print(usage)
         if check_tpu is not None:
             print("  device verbs also take --checked, --prewarm, "
-                  "--prededup, --por, --spill, --compile-cache=DIR "
+                  "--prededup, --por, --per-channel, --spill, "
+                  "--compile-cache=DIR "
                   "(docs/perf.md, docs/analysis.md, docs/spill.md) and "
                   "--watch (live status line, docs/telemetry.md)")
         if audit is not None:
@@ -121,7 +122,7 @@ def pop_perf(rest: list) -> tuple:
     work without the flags — these exist so one-off CLI runs can A/B."""
     rest = list(rest)
     cfg = {"prewarm": False, "prededup": False, "compile_cache": None,
-           "por": False, "spill": False}
+           "por": False, "spill": False, "per_channel": False}
     kept = []
     for a in rest:
         if a == "--prewarm":
@@ -132,6 +133,8 @@ def pop_perf(rest: list) -> tuple:
             cfg["por"] = True
         elif a == "--spill":
             cfg["spill"] = True
+        elif a == "--per-channel":
+            cfg["per_channel"] = True
         elif a.startswith("--compile-cache="):
             cfg["compile_cache"] = a[len("--compile-cache="):]
         else:
@@ -140,7 +143,10 @@ def pop_perf(rest: list) -> tuple:
 
 
 def apply_perf(builder, cfg: dict):
-    """Apply a :func:`pop_perf` config onto a ``CheckerBuilder``."""
+    """Apply a :func:`pop_perf` config onto a ``CheckerBuilder``.
+    ``per_channel`` is NOT applied here — it is a model-level encoding
+    choice that must land before the tensor twin resolves; device verbs
+    call :func:`apply_encoding` on the model first."""
     if cfg.get("prewarm"):
         builder = builder.prewarm()
     if cfg.get("prededup"):
@@ -152,6 +158,29 @@ def apply_perf(builder, cfg: dict):
     if cfg.get("compile_cache"):
         builder = builder.compile_cache(cfg["compile_cache"])
     return builder
+
+
+def apply_encoding(model, cfg: dict):
+    """Apply the :func:`pop_perf` ``--per-channel`` flag onto the MODEL
+    (``ActorModel.per_channel_()``): the per-(src,dst)-channel network
+    packing for the compiled device twin (docs/analysis.md "Per-channel
+    encoding").  Must run before the twin resolves — the encoding is the
+    fingerprint scheme.  Models without the builder method (non-actor
+    models like 2pc) get a LOUD one-liner instead of a silent no-op —
+    an ignored flag must never masquerade as "per-channel buys
+    nothing"."""
+    if cfg.get("per_channel"):
+        if hasattr(model, "per_channel_"):
+            model.per_channel_()
+        else:
+            print(
+                f"stateright-tpu: --per-channel ignored: "
+                f"{type(model).__name__} is not an actor model (the "
+                "encoding applies to compiled actor twins; "
+                "docs/analysis.md)",
+                file=sys.stderr,
+            )
+    return model
 
 
 # -- live watch view (--watch on the device verbs) ---------------------------
@@ -465,8 +494,12 @@ def independence_and_report(
             f"{s['independent_pairs']} independent pair(s), "
             f"{s['visible_actions']} visible, "
             f"{s['undecided_actions']} undecided; "
-            f"decomposed={s['decomposed']}; rules fired: "
-            f"{', '.join(s['rules']) or 'none'}",
+            f"decomposed={s['decomposed']}"
+            + (
+                f"; encoding={s['encoding']}"
+                if s.get("encoding") else ""
+            )
+            + f"; rules fired: {', '.join(s['rules']) or 'none'}",
             file=stream,
         )
         if not well_formed:

@@ -27,6 +27,7 @@ from ..actor.device_props import exists_actor, forall_actors
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -177,7 +178,7 @@ def main(argv=None) -> None:
             f"Model checking {n} dining philosophers on the device "
             "wavefront engine."
         )
-        m = dining_model(n)
+        m = apply_encoding(dining_model(n), perf)
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return

@@ -31,6 +31,7 @@ from ..actor.register import (
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -306,7 +307,7 @@ def main(argv=None):
             f"Model checking a linearizable register with {client_count} "
             "clients on the device wavefront engine."
         )
-        m = abd_model(client_count, 2, network)
+        m = apply_encoding(abd_model(client_count, 2, network), perf)
         if m.tensor_model() is None:
             print(
                 f"the {network.name} network has no device twin here: "

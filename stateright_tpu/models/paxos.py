@@ -37,6 +37,7 @@ from ..actor.register import (
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -222,6 +223,10 @@ class PaxosModel(TensorBackedModel, ActorModel):
             )
             and not self.lossy
             and isinstance(self.init_network, UnorderedNonDuplicatingNetwork)
+            # per-channel is a compiled-twin layout: the hand-tuned twin
+            # packs its own slot multiset, so the builder flag OR the env
+            # knob routes to the mechanical compiler (docs/analysis.md)
+            and not self.per_channel_resolved()
         ):
             return PaxosTensor(self, len(clients))
         return self._compiled_tensor(len(clients))
@@ -320,7 +325,7 @@ def main(argv=None):
             "on the device wavefront engine"
             + (" (checked mode)." if checked else ".")
         )
-        m = paxos_model(client_count, 3)
+        m = apply_encoding(paxos_model(client_count, 3), perf)
         if m.tensor_model() is None:
             print(
                 "this configuration has no device twin; use `check` (CPU)"

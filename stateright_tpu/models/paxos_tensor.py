@@ -75,6 +75,12 @@ class PaxosTensor(TensorModel):
     non-duplicating network (the reference benchmark configuration,
     ``examples/paxos.rs:323-338``)."""
 
+    #: this hand-tuned twin packs the network as ONE sorted slot multiset
+    #: too, so the independence analysis's JX305 escape-hatch pointer
+    #: applies: ``PaxosModel.per_channel_()`` routes to the mechanical
+    #: compiler's per-channel layout (docs/analysis.md)
+    network_encoding = "slot-multiset"
+
     def __init__(self, model, client_count: int, n_slots: int | None = None):
         if client_count > MAX_CLIENTS:
             raise ValueError(

@@ -35,6 +35,7 @@ from ..actor.device_props import exists_actor, forall_actor_pairs
 from ..core import Expectation
 from ..parallel.tensor_model import TensorBackedModel
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -254,7 +255,7 @@ def main(argv=None) -> None:
             f"Model checking Raft leader election with {n} servers on the "
             "device wavefront engine (mechanical symmetry reduction)."
         )
-        m = raft_model(n, network=network)
+        m = apply_encoding(raft_model(n, network=network), perf)
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check-sym`")
             return
@@ -272,7 +273,7 @@ def main(argv=None) -> None:
             f"Model checking Raft leader election with {n} servers on the "
             "device wavefront engine."
         )
-        m = raft_model(n, network=network)
+        m = apply_encoding(raft_model(n, network=network), perf)
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return

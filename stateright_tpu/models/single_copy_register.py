@@ -27,6 +27,7 @@ from ..actor.register import (
 from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, Register
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -135,7 +136,7 @@ def main(argv=None):
             f"Model checking a single-copy register with {client_count} "
             "clients on the device wavefront engine."
         )
-        m = single_copy_model(client_count, 1, network)
+        m = apply_encoding(single_copy_model(client_count, 1, network), perf)
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return

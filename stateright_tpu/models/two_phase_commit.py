@@ -25,6 +25,7 @@ from .. import Model, Property
 from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
 from ..symmetry import RewritePlan
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -409,10 +410,9 @@ def main(argv=None):
             f"Checking two phase commit with {rm_count} RMs on TPU"
             + (" (checked mode)." if checked else ".")
         )
+        m = apply_encoding(TwoPhaseSys(rm_count), perf)
         spawn_watched(
-            apply_perf(
-                TwoPhaseSys(rm_count).checker().checked(checked), perf
-            ),
+            apply_perf(m.checker().checked(checked), perf),
             watch, lambda b: b.spawn_tpu(),
         ).report()
 
@@ -426,11 +426,9 @@ def main(argv=None):
             "using symmetry reduction"
             + (" (checked mode)." if checked else ".")
         )
+        m = apply_encoding(TwoPhaseSys(rm_count), perf)
         spawn_watched(
-            apply_perf(
-                TwoPhaseSys(rm_count).checker().checked(checked).symmetry(),
-                perf,
-            ),
+            apply_perf(m.checker().checked(checked).symmetry(), perf),
             watch, lambda b: b.spawn_tpu(),
         ).report()
 

@@ -37,6 +37,7 @@ from ..parallel.tensor_model import TensorBackedModel
 from ..semantics import LinearizabilityTester, WORegister
 from ..symmetry import RewritePlan, rewrite_value
 from ._cli import (
+    apply_encoding,
     apply_perf,
     default_threads,
     make_audit_cmd,
@@ -178,7 +179,9 @@ def main(argv=None):
             f"clients and {server_count} servers on the device wavefront "
             "engine."
         )
-        m = wo_register_model(client_count, server_count, network)
+        m = apply_encoding(
+            wo_register_model(client_count, server_count, network), perf
+        )
         if m.tensor_model() is None:
             print("this configuration has no device twin; use `check` (CPU)")
             return

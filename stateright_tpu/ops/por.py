@@ -53,15 +53,18 @@ def conjunct_truth(enabled, rows, plan, kernel):
     tensor would pull only conjunct 0's writers, which is NOT a
     necessary enabling set).
 
-    Per action: kernel leaves where the action has an extracted and-tree,
-    the enabled bit itself for the whole-guard fallback, True padding
-    past an action's conjunct count (padded slots pair with all-False
-    enabler rows and are never selected)."""
+    Per action: kernel leaves where the action has an extracted and-tree
+    (a ``(leaf, lane)`` reference picks lane ``lane`` of a ``[B, cap]``
+    guard-block leaf — the per-channel kernel's one-array-per-channel
+    idiom — or the whole ``[B]`` leaf when lane is None), the enabled
+    bit itself for the whole-guard fallback, True padding past an
+    action's conjunct count (padded slots pair with all-False enabler
+    rows and are never selected)."""
     import jax.numpy as jnp
 
     _, en, _, leaf_idx = plan_constants(plan)
     a, k = en.shape[0], en.shape[1]
-    leaves = kernel(rows) if kernel is not None else None  # [B, L] | None
+    leaves = kernel(rows) if kernel is not None else None  # [arrays] | None
     if leaves is None and any(idx is not None for idx in leaf_idx):
         return None  # drift: truths for multi-conjunct actions unknown
     ones = jnp.ones_like(enabled[:, 0])
@@ -69,7 +72,10 @@ def conjunct_truth(enabled, rows, plan, kernel):
     for i in range(a):
         idx = leaf_idx[i] if leaves is not None else None
         col = (
-            [leaves[:, j] for j in idx]
+            [
+                leaves[j] if lane is None else leaves[j][:, lane]
+                for (j, lane) in idx
+            ]
             if idx is not None else [enabled[:, i]]
         )
         col = col + [ones] * (k - len(col))

@@ -548,6 +548,11 @@ class WavefrontChecker(Checker):
         out = {
             "enabled": bool(self._por),
             "fallback": self._por_fallback,
+            # which network packing the twin runs under (compiled actor
+            # twins: "slot-multiset" | "per-channel"; hand-written twins
+            # carry no encoding attribute) — reduction on the actor fleet
+            # exists only under per-channel (docs/analysis.md)
+            "encoding": getattr(self.tensor, "network_encoding", None),
         }
         stats = None
         if self._results and "por" in self._results:

@@ -70,6 +70,7 @@ from .actor_tensor import (
     COUNT_MASK,
     SLOT_EMPTY,
     SlotCodec,
+    region_send_ordered,
     slot_canonicalize,
     slot_send,
     slot_send_ordered,
@@ -100,6 +101,8 @@ def compile_actor_model(
     max_states_per_actor: int = 200_000,
     max_envelopes: int = 100_000,
     max_history_states: int = 2_000_000,
+    per_channel: Optional[bool] = None,
+    per_channel_depth: Optional[int] = None,
 ) -> "CompiledActorTensor":
     """Compile ``model`` to a :class:`TensorModel`; raises
     :class:`CompileError` when the model is outside the supported fragment
@@ -109,6 +112,21 @@ def compile_actor_model(
     ``env_bound(envelope) -> bool`` cut the closure's over-approximation for
     protocols with context-dependent domains; transitions crossing the bound
     poison the row on device rather than silently diverging.
+
+    ``per_channel`` selects the network packing (default None: the model's
+    ``per_channel_()`` builder state, else ``STATERIGHT_TPU_PER_CHANNEL=1``):
+    False = the global sorted-slot multiset; True = one slot region per
+    directed ``(src, dst)`` channel, sized to that channel's envelope
+    universe — wider rows, but delivery writes become statically confined,
+    the independence analysis decomposes the action stack (no ``JX302``),
+    and ``por()`` gets real reduction (``docs/analysis.md``).
+
+    ``per_channel_depth`` raises each ORDERED channel's region capacity to
+    at least this many slots: an ordered flow can hold the SAME message at
+    several ranks (retransmits), which needs more slots than the channel's
+    distinct-code count.  The default (the code count) poisons LOUDLY when
+    exceeded — never silently diverging — and unordered regions ignore the
+    knob (their capacity is already exact).
     """
     return CompiledActorTensor(
         model,
@@ -118,6 +136,8 @@ def compile_actor_model(
         max_states_per_actor=max_states_per_actor,
         max_envelopes=max_envelopes,
         max_history_states=max_history_states,
+        per_channel=per_channel,
+        per_channel_depth=per_channel_depth,
     )
 
 
@@ -134,8 +154,21 @@ class CompiledActorTensor(TensorModel):
         max_states_per_actor,
         max_envelopes,
         max_history_states,
+        per_channel=None,
+        per_channel_depth=None,
     ):
         self.model = model
+        if per_channel is None:
+            # the ONE resolution rule (builder flag, else env knob) lives
+            # on ActorModel — compiled inputs are always ActorModels
+            per_channel = model.per_channel_resolved()
+        self.per_channel = bool(per_channel)
+        self._per_channel_depth = per_channel_depth
+        #: which row layout packs the network — surfaced by por_status(),
+        #: the run report, and the Explorer /.status por block
+        self.network_encoding = (
+            "per-channel" if self.per_channel else "slot-multiset"
+        )
         self._check_fragment()
         # multi-op register workload (put_count >= 2): per-thread op-index
         # history fields + the MultiOpLinHistoryCodec table strategy
@@ -214,12 +247,34 @@ class CompiledActorTensor(TensorModel):
         self._sym_tables = None
         self._sym_attempted = False
 
-        self.n_slots = n_slots if n_slots is not None else max(
-            16, 4 * self.n_actors
-        )
-        self.max_actions = self.n_slots * (2 if model.lossy else 1) + (
-            self.n_actors if self._has_timers else 0
-        )
+        if self.per_channel:
+            if n_slots is not None:
+                raise CompileError(
+                    "n_slots is a slot-multiset knob; the per-channel "
+                    "layout derives each region's capacity from its "
+                    "channel's envelope universe"
+                )
+            self._build_channel_layout()
+            self.n_slots = int(sum(self._ch_cap))
+            deliver = sum(
+                self._ch_cap[ci]
+                for ci, (_s, d) in enumerate(self._channels)
+                if d < self.n_actors
+            )
+            self.max_actions = max(
+                deliver
+                + (self.n_slots if model.lossy else 0)
+                + (self.n_actors if self._has_timers else 0),
+                1,  # a message-less, timer-less system still needs a
+                #     (never-valid) action column for the engine shapes
+            )
+        else:
+            self.n_slots = n_slots if n_slots is not None else max(
+                16, 4 * self.n_actors
+            )
+            self.max_actions = self.n_slots * (2 if model.lossy else 1) + (
+                self.n_actors if self._has_timers else 0
+            )
         fields = []
         for i in range(self.n_actors):
             bits = max(1, int(np.ceil(np.log2(max(2, len(self._states[i]))))))
@@ -678,6 +733,145 @@ class CompiledActorTensor(TensorModel):
                 sends.append(sc)
         return tuple(sends), teff, poison
 
+    # -- per-channel layout (ROADMAP "Per-channel network encoding") --------
+
+    def _build_channel_layout(self) -> None:
+        """Freeze the per-(src,dst)-channel row layout: one slot region
+        per directed channel of the envelope universe, capacity = that
+        channel's distinct-code count (so the unordered semantics can
+        NEVER overflow a region — a region full of distinct codes holds
+        every code of its channel), plus the static per-channel metadata
+        the channel step kernel keys its python-level structure on:
+        which channels can poison (table poisons), which carry
+        register-workload return kinds (history writers), which touch
+        the recipient's timer, and the per-send-slot target-channel sets
+        (what makes a send's writes statically confined)."""
+        chans: dict = {}
+        for c, e in enumerate(self._envs):
+            chans.setdefault(e.channel, []).append(c)
+        self._channels = sorted(chans)
+        self._ch_codes = [
+            np.asarray(chans[k], np.int32) for k in self._channels
+        ]
+        if self.ordered and self._per_channel_depth:
+            # ordered flows hold duplicates at distinct ranks, so a flow
+            # can outgrow its code universe (retransmits); the knob buys
+            # headroom, bounded by the rank field's width
+            self._ch_cap = [
+                min(
+                    max(len(chans[k]), int(self._per_channel_depth)),
+                    COUNT_MASK,
+                )
+                for k in self._channels
+            ]
+        else:
+            self._ch_cap = [len(chans[k]) for k in self._channels]
+        self._ch_base = []
+        base = 0
+        for cap in self._ch_cap:
+            self._ch_base.append(base)
+            base += cap
+        self._chan_of = np.full(self._ne_padded, -1, np.int32)
+        for ci, codes in enumerate(self._ch_codes):
+            self._chan_of[codes] = ci
+        n = self.n_actors
+        self._ch_poison_any = []
+        self._ch_ret_kind = []
+        self._ch_timer = []
+        self._ch_targets = []  # per channel: per send slot k, sorted cis
+        for ci, (_s, d) in enumerate(self._channels):
+            codes = self._ch_codes[ci]
+            if d >= n:  # undeliverable destination: no deliver action
+                self._ch_poison_any.append(False)
+                self._ch_ret_kind.append(False)
+                self._ch_timer.append(False)
+                self._ch_targets.append([])
+                continue
+            self._ch_poison_any.append(
+                bool(self._poison_np[d][:, codes].any())
+            )
+            # history updates apply only when the DESTINATION is a client
+            # (the multiset kernel's `ci >= 0` guard): a ret-kind envelope
+            # relayed to a server must not touch the history fields
+            self._ch_ret_kind.append(
+                bool((self._env_kind[codes] != _K_OTHER).any())
+                and int(self._client_of[d]) >= 0
+            )
+            self._ch_timer.append(
+                bool((self._teff_np[d][:, codes] != -1).any())
+            )
+            ks = self._sends_np[d][:, codes, :]
+            self._ch_targets.append([
+                sorted({
+                    int(self._chan_of[c])
+                    for c in np.unique(ks[..., k][ks[..., k] >= 0])
+                })
+                for k in range(max(self.K, 1))
+            ])
+        if self._has_timers:
+            self._t_targets = [
+                [
+                    sorted({
+                        int(self._chan_of[c])
+                        for c in np.unique(
+                            self._tsends_np[i][:, k][
+                                self._tsends_np[i][:, k] >= 0
+                            ]
+                        )
+                    })
+                    for k in range(max(self.Kt, 1))
+                ]
+                for i in range(n)
+            ]
+        #: channels whose codes include a chosen-capable (non-null get_ok)
+        #: envelope — the ONLY regions the per-channel "value chosen"
+        #: property reads, which is what keeps internal-channel deliveries
+        #: property-invisible for the POR C2 condition
+        self._chosen_channels = [
+            ci
+            for ci, codes in enumerate(self._ch_codes)
+            if bool(self._env_chosen[codes].any())
+        ]
+
+    def _pack_network(self, pairs) -> tuple:
+        """``[(envelope, count_or_rank), ...] -> slot words`` under the
+        active layout (the per-channel analogue of ``SlotCodec.pack``:
+        sorted per region, EMPTY-padded to each region's capacity)."""
+        if not self.per_channel:
+            return self.codec.pack(pairs)
+        per: list = [[] for _ in self._channels]
+        for env, count in pairs:
+            if not 1 <= count <= COUNT_MASK:
+                raise ValueError(f"count {count} out of range for {env!r}")
+            code = self._env_code[env]  # KeyError = outside the universe
+            per[int(self._chan_of[code])].append(
+                (code << COUNT_BITS) | count
+            )
+        words: list = []
+        for ci, lst in enumerate(per):
+            cap = self._ch_cap[ci]
+            if len(lst) > cap:
+                raise ValueError(
+                    f"channel {self._channels[ci]} holds {len(lst)} "
+                    f"envelopes, exceeding its region capacity {cap}"
+                )
+            lst.sort()
+            words += lst + [SLOT_EMPTY] * (cap - len(lst))
+        return tuple(words)
+
+    def _unpack_network(self, slot_words) -> list:
+        """``slot words -> [(envelope, count_or_rank), ...]`` under the
+        active layout."""
+        if not self.per_channel:
+            return self.codec.unpack(slot_words)
+        out = []
+        for w in slot_words:
+            w = int(w)
+            if w == SLOT_EMPTY:
+                continue
+            out.append((self._envs[w >> COUNT_BITS], w & COUNT_MASK))
+        return out
+
     def _tabulate_properties(self) -> None:
         """Freeze each factored property's predicate into per-actor (or
         per-pair) boolean tables over the compiled state universes.  The
@@ -998,7 +1192,7 @@ class CompiledActorTensor(TensorModel):
             pairs = ((env, 1) for env in st.network.iter_all())
         else:
             pairs = st.network._counts.items()
-        return self.pk.pack(**vals) + self.codec.pack(pairs)
+        return self.pk.pack(**vals) + self._pack_network(pairs)
 
     def decode_state(self, row) -> ActorModelState:
         d = self.pk.unpack(row[: self.pw])
@@ -1046,7 +1240,7 @@ class CompiledActorTensor(TensorModel):
             if self._has_timers
             else (False,) * self.n_actors
         )
-        pairs = self.codec.unpack(row[self.pw :])
+        pairs = self._unpack_network(row[self.pw :])
         if self.ordered:
             flows: dict = {}
             for env, rank1 in pairs:
@@ -1116,6 +1310,8 @@ class CompiledActorTensor(TensorModel):
                 self._device_consts["boundary"] = [
                     jnp.asarray(t) for t in self._boundary_np
                 ]
+            if self.per_channel:
+                self._device_consts["chan_of"] = jnp.asarray(self._chan_of)
             self._device_consts["props"] = [
                 None
                 if entry is None
@@ -1148,6 +1344,16 @@ class CompiledActorTensor(TensorModel):
         }
         dom = RowDomain.from_packer(self.pk, field_bounds=bounds,
                                     width=self.width)
+        if self.per_channel:
+            # per-region bounds: each channel's words hold only ITS codes,
+            # so the slot-word ceiling is the channel's max code — tighter
+            # than the global-universe bound of the slot-multiset layout
+            for ci, codes in enumerate(self._ch_codes):
+                hi = (int(codes.max()) << COUNT_BITS) | COUNT_MASK
+                base = self.pw + self._ch_base[ci]
+                for w in range(base, base + self._ch_cap[ci]):
+                    dom.declare_word(w, hi, may_empty=True)
+            return dom
         max_code = max(0, len(self._envs) - 1)
         slot_hi = (max_code << COUNT_BITS) | COUNT_MASK
         for w in range(self.pw, self.width):
@@ -1155,6 +1361,11 @@ class CompiledActorTensor(TensorModel):
         return dom
 
     def step_rows(self, rows):
+        if self.per_channel:
+            return self._step_rows_per_channel(rows)
+        return self._step_rows_multiset(rows)
+
+    def _step_rows_multiset(self, rows):
         import jax.numpy as jnp
 
         cst = self._consts()
@@ -1490,6 +1701,347 @@ class CompiledActorTensor(TensorModel):
             jnp.concatenate([valid, valid_t], axis=1),
         )
 
+    # -- per-channel step kernel --------------------------------------------
+
+    def _region(self, rows, ci: int):
+        """Channel ``ci``'s slot region: a static last-axis slice, so the
+        footprint pass keeps per-word lane tracking through it."""
+        base = self.pw + self._ch_base[ci]
+        return rows[..., base : base + self._ch_cap[ci]]
+
+    def _or_field(self, out, name: str, flag):
+        """OR ``flag`` (bool[...]) into the 1-bit packed field ``name``
+        WITHOUT reading it back through ``pk.get``: the lane stays an
+        identity of its own word with one OR-accumulated bit, which the
+        footprint pass classifies as an accumulator write (monotone, so
+        two actions' poison writes commute; ``docs/analysis.md``)."""
+        import jax.numpy as jnp
+
+        word, off, _bits = self.pk.layout[name]
+        v = flag.astype(jnp.uint64)
+        if off:
+            v = v << jnp.uint64(off)
+        return out.at[..., word].set(out[..., word] | v)
+
+    def _channel_history(self, outp, valid, ecode, c, cst, B, cap):
+        """Register-workload history update for ONE client channel (the
+        per-channel twin's analogue of the all-clients history loop in
+        the multiset kernel): ``c`` is the client index of the channel's
+        static destination; masks are [B, cap] over the channel's slots."""
+        import jax.numpy as jnp
+
+        i32, u64 = jnp.int32, jnp.uint64
+        pk = self.pk
+        kind = cst["env_kind"][ecode]  # [B, cap]
+        rv = cst["env_val"][ecode]
+        phases = jnp.stack(
+            [
+                pk.get(outp, f"h{j}_phase").astype(i32)[:, 0]
+                for j in range(self.C)
+            ],
+            -1,
+        )  # [B, C] (outp rows are pre-update copies of the input fields)
+        if self._multi:
+            K = self.hist.K
+            eb = self.hist.snap_entry_bits
+            m_w = valid & (kind == _K_PUT_OK)
+            m_r = valid & (kind == _K_GET_OK)
+            comp = phases >> 1
+            cur_ph = pk.get(outp, f"h{c}_phase").astype(i32)
+            new_ph = jnp.where(
+                m_w, cur_ph + 2, jnp.where(m_r, cur_ph + 1, cur_ph)
+            )
+            outp = pk.set(outp, f"h{c}_phase", new_ph.astype(u64))
+            cur_comp = cur_ph >> 1
+            snap = jnp.zeros((B, cap), i32)
+            for j in range(self.C):
+                if j == c:
+                    continue
+                slot = self.hist._snap_slot(c, j)
+                snap = snap | (comp[:, j : j + 1] << (eb * slot))
+            for m in range(K):
+                sel = m_w & (cur_comp == m)
+                cur_snap = pk.get(outp, f"h{c}_snap{m}").astype(i32)
+                outp = pk.set(
+                    outp,
+                    f"h{c}_snap{m}",
+                    jnp.where(sel, snap, cur_snap).astype(u64),
+                )
+            cur_rv = pk.get(outp, f"h{c}_rval").astype(i32)
+            return pk.set(
+                outp, f"h{c}_rval", jnp.where(m_r, rv, cur_rv).astype(u64)
+            )
+        m_w = valid & ((kind == _K_PUT_OK) | (kind == _K_PUT_FAIL))
+        m_r = valid & (kind == _K_GET_OK)
+        comp = jnp.where(
+            phases == PHASE_W_INFLIGHT,
+            0,
+            jnp.where(phases == PHASE_DONE, 2, 1),
+        )
+        cur_ph = pk.get(outp, f"h{c}_phase").astype(i32)
+        new_ph = jnp.where(
+            m_w, PHASE_R_INFLIGHT, jnp.where(m_r, PHASE_DONE, cur_ph)
+        )
+        outp = pk.set(outp, f"h{c}_phase", new_ph.astype(u64))
+        if self.C > 1:
+            snap = jnp.zeros((B, cap), i32)
+            for j in range(self.C):
+                if j == c:
+                    continue
+                slot = self.hist._snap_slot(c, j)
+                snap = snap | (comp[:, j : j + 1] << (2 * slot))
+            cur_snap = pk.get(outp, f"h{c}_snap").astype(i32)
+            outp = pk.set(
+                outp,
+                f"h{c}_snap",
+                jnp.where(m_w, snap, cur_snap).astype(u64),
+            )
+        cur_rv = pk.get(outp, f"h{c}_rval").astype(i32)
+        outp = pk.set(
+            outp, f"h{c}_rval", jnp.where(m_r, rv, cur_rv).astype(u64)
+        )
+        if self.hist.wfail_bits:
+            m_wf = m_w & (kind == _K_PUT_FAIL)
+            cur_wf = pk.get(outp, f"h{c}_wfail").astype(i32)
+            outp = pk.set(
+                outp,
+                f"h{c}_wfail",
+                jnp.where(m_wf, 1, cur_wf).astype(u64),
+            )
+        return outp
+
+    def _assemble_piece(self, outp, rows, lead, work):
+        """One action family's row piece ``[B, lead, W]``: the updated
+        packed words plus every slot region — touched regions
+        (re-canonicalized members of ``work``) in place, untouched
+        regions as pure broadcast copies of the input slice, which is
+        exactly what keeps their footprint a no-write."""
+        import jax.numpy as jnp
+
+        B = rows.shape[0]
+        parts = [outp]
+        for t in range(len(self._channels)):
+            if t in work:
+                parts.append(slot_canonicalize(work[t]))
+            else:
+                parts.append(jnp.broadcast_to(
+                    self._region(rows, t)[:, None, :],
+                    (B, lead, self._ch_cap[t]),
+                ))
+        return jnp.concatenate(parts, axis=-1)
+
+    def _apply_sends(self, work, rows, valid, send_codes, targets, cst,
+                     lead):
+        """Apply one action family's sends, confined per STATIC target
+        channel: ``send_codes`` [B, lead, K]; ``targets[k]`` lists the
+        channels send slot ``k`` can reach (from the frozen tables).
+        Returns the overflow mask [B, lead] (False where statically
+        impossible — duplicating regions sized to their code universe
+        can never overflow, so those actions carry no poison write at
+        all)."""
+        import jax.numpy as jnp
+
+        u64 = jnp.uint64
+        B = rows.shape[0]
+        overflow = None
+        n_k = send_codes.shape[-1]
+        for k in range(n_k):
+            if k >= len(targets):
+                break
+            sk = send_codes[..., k]  # [B, lead]
+            for t in targets[k]:
+                cur = work.get(t)
+                if cur is None:
+                    cur = jnp.broadcast_to(
+                        self._region(rows, t)[:, None, :],
+                        (B, lead, self._ch_cap[t]),
+                    )
+                en = valid & (sk >= 0) & (
+                    cst["chan_of"][jnp.maximum(sk, 0)] == t
+                )
+                if self.ordered:
+                    cur, of = region_send_ordered(cur, sk.astype(u64), en)
+                else:
+                    cur, of = slot_send(
+                        cur, sk.astype(u64), en, set_semantics=self.dup
+                    )
+                work[t] = cur
+                if not self.dup:  # set-semantics regions cannot overflow
+                    overflow = of if overflow is None else (overflow | of)
+        return overflow
+
+    def _step_rows_per_channel(self, rows):
+        """The per-channel twin's step: the successor stack is assembled
+        as one action-axis ``concatenate`` of per-channel pieces whose
+        writes are statically confined — its own region (consume), the
+        recipient's packed fields, and the send-target regions — so the
+        footprint pass decomposes it per action and the conflict matrix
+        stops being all-dependent (no ``JX302``; docs/analysis.md
+        "Per-channel encoding")."""
+        import jax.numpy as jnp
+
+        cst = self._consts()
+        i32, u64 = jnp.int32, jnp.uint64
+        B = rows.shape[0]
+        ne = self._ne_padded
+        pk = self.pk
+        n = self.n_actors
+        EMPTYW = u64(SLOT_EMPTY)
+
+        pieces, valids = [], []
+
+        packed = rows[:, : self.pw]  # slice FIRST, then expand: the
+        # one-step `rows[:, None, :pw]` indexing lowers to a form the
+        # footprint pass cannot keep lane-tracked, and every packed-word
+        # footprint would collapse to read-everything
+
+        def packed_broadcast(lead):
+            return jnp.broadcast_to(packed[:, None, :], (B, lead, self.pw))
+
+        def region_view(ci):
+            cap = self._ch_cap[ci]
+            reg = self._region(rows, ci)  # [B, cap]
+            occ = reg != EMPTYW
+            ecode = jnp.where(
+                occ,
+                (reg >> u64(COUNT_BITS)).astype(i32),
+                i32(int(self._ch_codes[ci][0])),
+            )
+            return cap, reg, occ, ecode
+
+        def consumed(ci, cap, reg, occ):
+            """[B, cap(action), cap(word)] region after consuming slot
+            ``a`` (one copy / the flow head) — the non-duplicating
+            deliver/drop effect; dup deliveries skip this entirely."""
+            reg_b = jnp.broadcast_to(reg[:, None, :], (B, cap, cap))
+            diag = jnp.eye(cap, dtype=bool)[None]
+            if self.ordered:
+                occ_b = jnp.broadcast_to(occ[:, None, :], (B, cap, cap))
+                return jnp.where(
+                    diag, EMPTYW,
+                    jnp.where(occ_b, reg_b - u64(1), reg_b),
+                )
+            count = reg & u64(COUNT_MASK)
+            gone = jnp.where(count <= u64(1), EMPTYW, reg - u64(1))
+            return jnp.where(diag, gone[:, :, None], reg_b)
+
+        # -- deliver actions: one per (channel, slot) -----------------------
+        for ci, (_s, d) in enumerate(self._channels):
+            if d >= n:
+                continue
+            cap, reg, occ, ecode = region_view(ci)
+            sc = pk.get(rows, f"a{d}").astype(i32)[:, None]  # [B, 1]
+            flat = sc * ne + ecode  # [B, cap]
+            nc = cst["trans"][d].reshape(-1)[flat]
+            valid = occ & (nc >= 0)
+            if self.ordered:
+                valid = valid & ((reg & u64(COUNT_MASK)).astype(i32) == 1)
+            poison = None
+            if self._ch_poison_any[ci]:
+                poison = occ & cst["poison"][d].reshape(-1)[flat]
+
+            if self.dup:
+                work = {}
+            else:
+                work = {ci: consumed(ci, cap, reg, occ)}
+            ks = cst["sends"][d].reshape(-1, max(self.K, 1))[flat]
+            of = self._apply_sends(
+                work, rows, valid, ks, self._ch_targets[ci], cst, cap
+            )
+            if of is not None:
+                poison = of if poison is None else (poison | of)
+
+            outp = packed_broadcast(cap)
+            outp = pk.set(
+                outp, f"a{d}", jnp.where(valid, nc, sc).astype(u64)
+            )
+            if self._ch_ret_kind[ci] and self.C:
+                outp = self._channel_history(
+                    outp, valid, ecode, int(self._client_of[d]), cst, B,
+                    cap,
+                )
+            if self._has_timers and self._ch_timer[ci]:
+                eff = cst["teff"][d].reshape(-1)[flat]  # [B, cap]
+                tcur = pk.get(rows, "timers").astype(i32)[:, None]
+                bit = (tcur >> d) & 1
+                nb = jnp.where(
+                    valid & (eff == 1),
+                    1,
+                    jnp.where(valid & (eff == 0), 0, bit),
+                )
+                tnew = (tcur & ~(1 << d)) | (nb << d)
+                outp = pk.set(outp, "timers", tnew.astype(u64))
+            if poison is not None:
+                outp = self._or_field(outp, "poison", poison)
+            pieces.append(self._assemble_piece(outp, rows, cap, work))
+            valids.append(valid)
+
+        # -- drop actions (lossy): every channel, network-only effect -------
+        if self.model.lossy:
+            for ci in range(len(self._channels)):
+                cap, reg, occ, _ecode = region_view(ci)
+                if self.dup:
+                    # only drops remove from a duplicating network
+                    reg_b = jnp.broadcast_to(
+                        reg[:, None, :], (B, cap, cap)
+                    )
+                    dropped = jnp.where(
+                        jnp.eye(cap, dtype=bool)[None], EMPTYW, reg_b
+                    )
+                    droppable = occ
+                else:
+                    # a drop's network effect IS the deliver consume
+                    dropped = consumed(ci, cap, reg, occ)
+                    droppable = occ & (
+                        (reg & u64(COUNT_MASK)).astype(i32) == 1
+                    ) if self.ordered else occ
+                pieces.append(self._assemble_piece(
+                    packed_broadcast(cap), rows, cap, {ci: dropped}
+                ))
+                valids.append(droppable)
+
+        # -- timeout actions: one per actor ---------------------------------
+        if self._has_timers:
+            tcur_all = pk.get(rows, "timers").astype(i32)  # [B]
+            for i in range(n):
+                sc = pk.get(rows, f"a{i}").astype(i32)  # [B]
+                nc = cst["ttrans"][i][sc]
+                nb = cst["tbit"][i][sc]
+                valid_i = (((tcur_all >> i) & 1) == 1)[:, None]  # [B, 1]
+                outp = packed_broadcast(1)
+                outp = pk.set(
+                    outp,
+                    f"a{i}",
+                    jnp.where(valid_i, nc[:, None], sc[:, None]).astype(
+                        u64
+                    ),
+                )
+                tnew = (tcur_all[:, None] & ~(1 << i)) | (nb[:, None] << i)
+                outp = pk.set(outp, "timers", tnew.astype(u64))
+                work: dict = {}
+                ks = cst["tsends"][i][sc][:, None, :]  # [B, 1, Kt]
+                of = self._apply_sends(
+                    work, rows, valid_i, ks, self._t_targets[i], cst, 1
+                )
+                poison = None
+                if bool(self._tpoison_np[i].any()):
+                    poison = valid_i & cst["tpoison"][i][sc][:, None]
+                if of is not None:
+                    poison = of if poison is None else (poison | of)
+                if poison is not None:
+                    outp = self._or_field(outp, "poison", poison)
+                pieces.append(self._assemble_piece(outp, rows, 1, work))
+                valids.append(valid_i)
+
+        if not pieces:  # message-less, timer-less: one never-valid column
+            return (
+                rows[:, None, :],
+                jnp.zeros((B, 1), bool),
+            )
+        succ = jnp.concatenate(pieces, axis=1)
+        valid = jnp.concatenate(valids, axis=-1)
+        return succ, valid
+
     @property
     def has_boundary(self) -> bool:
         return self._boundary_np is not None
@@ -1610,10 +2162,31 @@ class CompiledActorTensor(TensorModel):
                 keys = self.hist.device_key(phases, snaps, rvals, wfails)
                 linearizable = self.hist.device_lookup(keys)
 
-        slots = rows[:, self.pw :]
-        occ = slots != u64(SLOT_EMPTY)
-        ecode = jnp.where(occ, (slots >> u64(COUNT_BITS)).astype(i32), 0)
-        chosen = jnp.any(occ & cst["env_chosen"][ecode], axis=-1)
+        if self.per_channel:
+            # read ONLY the chosen-capable channels' regions: get_ok
+            # envelopes live on statically-known server→client channels,
+            # and confining the property's read footprint there is what
+            # keeps internal-channel deliveries invisible (the POR C2
+            # condition; docs/analysis.md "Per-channel encoding")
+            chosen = jnp.zeros((rows.shape[0],), bool)
+            for ci in self._chosen_channels:
+                reg = self._region(rows, ci)
+                r_occ = reg != u64(SLOT_EMPTY)
+                r_code = jnp.where(
+                    r_occ,
+                    (reg >> u64(COUNT_BITS)).astype(i32),
+                    i32(int(self._ch_codes[ci][0])),
+                )
+                chosen = chosen | jnp.any(
+                    r_occ & cst["env_chosen"][r_code], axis=-1
+                )
+        else:
+            slots = rows[:, self.pw :]
+            occ = slots != u64(SLOT_EMPTY)
+            ecode = jnp.where(
+                occ, (slots >> u64(COUNT_BITS)).astype(i32), 0
+            )
+            chosen = jnp.any(occ & cst["env_chosen"][ecode], axis=-1)
 
         masks = {"linearizable": linearizable, "value chosen": chosen}
         return jnp.stack(

@@ -13,6 +13,24 @@ for free from order-insensitive hashing (``src/util.rs:124-145``).  Because
 ``envelope_code`` occupies the high bits and equal multisets have equal
 counts per code, sorting by the whole word is sorting by code.
 
+Two row layouts share this slot-word format
+(``parallel/actor_compiler.py``):
+
+ - the default **slot multiset** — one global sorted region for every
+   envelope, simplest and narrowest, but a delivery's destination is
+   message DATA, so the independence analysis cannot confine its writes
+   (finding ``JX302``) and partial-order reduction gets nothing;
+ - the opt-in **per-channel layout** — one region per directed
+   ``(src, dst)`` channel, sorted per region, sized to that channel's
+   envelope universe.  A delivery's writes are then statically confined
+   to its own channel's words (plus the recipient's packed fields and
+   the statically-known send-target regions), which is what turns the
+   ample-set machinery into real reduction on the consensus fleet
+   (``docs/analysis.md`` "Per-channel encoding").
+
+The batched ops below are region-agnostic: they operate on whatever slot
+region the caller slices out, so both layouts reuse them.
+
 Device ops (all pure, jittable, batched over leading axes):
 
  - :func:`slot_deliver` — decrement count at a slot index; free at zero.
@@ -171,3 +189,27 @@ def slot_send_ordered(slots, code, pair_lookup, enable):
 def slot_canonicalize(slots):
     """Sort slots ascending; EMPTY (all-ones) sinks to the end."""
     return jnp.sort(slots, axis=-1)
+
+
+def region_send_ordered(reg, code, enable):
+    """Ordered append for the PER-CHANNEL packing: ``reg`` is one directed
+    channel's slot region, which under the per-channel layout IS a single
+    FIFO flow — no ``pair_lookup`` needed (contrast
+    :func:`slot_send_ordered`, which disambiguates flows inside the global
+    slot multiset).  Appends ``code`` at the tail: the claimed slot's
+    count bits get rank ``1 + |occupied slots in the region|``.  Returns
+    ``(reg, overflow)``; overflow = no free slot, or the flow is already
+    ``COUNT_MASK`` deep (the rank would corrupt the code bits)."""
+    n = reg.shape[-1]
+    occ = slot_occupied(reg)
+    depth = jnp.sum(occ, axis=-1).astype(jnp.uint64)
+    free = ~occ
+    first_free = jnp.argmax(free, axis=-1)
+    any_free = jnp.any(free, axis=-1)
+    too_deep = depth >= jnp.uint64(COUNT_MASK)
+    claim = enable & any_free & ~too_deep
+    onehot = (jnp.arange(n) == first_free[..., None]) & claim[..., None]
+    neww = (code << jnp.uint64(COUNT_BITS)) | (depth + jnp.uint64(1))
+    claimed = jnp.where(onehot, neww[..., None], reg)
+    overflow = enable & (~any_free | too_deep)
+    return claimed, overflow

@@ -1,7 +1,14 @@
-"""Ping-pong actor fixture (reference ``src/actor/actor_test_util.rs``).
+"""Actor fixtures for the compiler/engine tests.
 
-Two actors bounce a counter; history optionally tracks (#in, #out) message
-counts; six properties span all three expectations.
+ - ping-pong (reference ``src/actor/actor_test_util.rs``): two actors
+   bounce a counter; history optionally tracks (#in, #out) message
+   counts; six properties span all three expectations.
+ - actor-form two-phase commit (:func:`actor_2pc_model`): the
+   Gray/Lamport 2pc recast as real actors over an unordered DUPLICATING
+   network — the persistent envelope set mirrors the TLA+ model's
+   monotonic message set, which makes it the duplicating-semantics
+   exemplar for the per-channel network-encoding parity tests
+   (``tests/test_per_channel.py``).
 """
 
 from __future__ import annotations
@@ -10,7 +17,8 @@ from dataclasses import dataclass
 from typing import Optional
 
 from stateright_tpu import Expectation
-from stateright_tpu.actor import Actor, ActorModel, Id, Out
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
+from stateright_tpu.parallel.tensor_model import TensorBackedModel
 
 
 @dataclass
@@ -92,3 +100,134 @@ def ping_pong_model(cfg: PingPongCfg) -> ActorModel:
             lambda m, s: s.history[1] <= s.history[0] + 1,
         )
     )
+
+
+# -- actor-form two-phase commit ---------------------------------------------
+
+RM_WORKING, RM_PREPARED, RM_COMMITTED, RM_ABORTED = (
+    "working", "prepared", "committed", "aborted"
+)
+
+
+@dataclass
+class TwoPhaseRmActor(Actor):
+    """One resource manager.  Its spontaneous choices (prepare / choose
+    abort) arrive as self-addressed seed envelopes that the duplicating
+    network keeps deliverable forever, TLA-style."""
+
+    tm: Id
+
+    def on_start(self, id, out):
+        return RM_WORKING
+
+    def on_msg(self, id, state, src, msg, out):
+        kind = msg[0]
+        if kind == "do_prepare" and state == RM_WORKING:
+            out.send(self.tm, ("prepared", int(id)))
+            return RM_PREPARED
+        if kind == "do_abort" and state == RM_WORKING:
+            return RM_ABORTED
+        if kind == "commit" and state == RM_PREPARED:
+            return RM_COMMITTED
+        if kind == "abort" and state in (RM_WORKING, RM_PREPARED):
+            return RM_ABORTED
+        return None
+
+
+@dataclass
+class TwoPhaseTmActor(Actor):
+    """The transaction manager: collects ``prepared`` votes, broadcasts
+    commit on a full quorum; a persistent self-addressed ``do_abort``
+    seed lets it abort at any point while undecided."""
+
+    rm_ids: list
+
+    def on_start(self, id, out):
+        return ("init", frozenset())
+
+    def on_msg(self, id, state, src, msg, out):
+        phase, prepared = state
+        kind = msg[0]
+        if kind == "prepared" and phase == "init":
+            prepared = prepared | {int(msg[1])}
+            if len(prepared) == len(self.rm_ids):
+                for r in self.rm_ids:
+                    out.send(r, ("commit",))
+                return ("committed", prepared)
+            return (phase, prepared)
+        if kind == "do_abort" and phase == "init":
+            for r in self.rm_ids:
+                out.send(r, ("abort",))
+            return ("aborted", prepared)
+        return None
+
+
+class Actor2pcModel(TensorBackedModel, ActorModel):
+    """Tensor-backed actor 2pc (mechanically compiled twin)."""
+
+    def tensor_model(self):
+        from stateright_tpu.parallel.actor_compiler import (
+            CompileError,
+            compile_actor_model,
+        )
+
+        try:
+            return compile_actor_model(self)
+        except (CompileError, ValueError):
+            return None
+
+
+def actor_2pc_model(rm_count: int = 3, lossy: bool = False,
+                    network: Optional[Network] = None) -> ActorModel:
+    """TM at index 0, RMs at 1..rm_count; duplicating network by default
+    (the message-set reading of the TLA+ model)."""
+    from stateright_tpu.actor.device_props import (
+        exists_actor,
+        forall_actor_pairs,
+    )
+
+    if network is None:
+        network = Network.new_unordered_duplicating()
+    rm_ids = [Id(i + 1) for i in range(rm_count)]
+    m = Actor2pcModel(cfg=None, init_history=None)
+    m.actor(TwoPhaseTmActor(rm_ids=rm_ids))
+    for _ in rm_ids:
+        m.actor(TwoPhaseRmActor(tm=Id(0)))
+    # self-addressed choice seeds: spontaneous TLA actions as deliveries
+    for r in rm_ids:
+        network = network.send(__envelope(r, r, ("do_prepare",)))
+        network = network.send(__envelope(r, r, ("do_abort",)))
+    network = network.send(__envelope(Id(0), Id(0), ("do_abort",)))
+    m.init_network_(network)
+    m.lossy_network(lossy)
+
+    def _is_rm(s):
+        return isinstance(s, str)
+
+    m.property(
+        Expectation.ALWAYS,
+        "consistent",
+        forall_actor_pairs(
+            lambda i, si, j, sj: not (
+                _is_rm(si) and _is_rm(sj)
+                and {si, sj} == {RM_COMMITTED, RM_ABORTED}
+            )
+        ),
+    )
+    m.property(
+        Expectation.SOMETIMES,
+        "commit reached",
+        exists_actor(lambda i, s: s == RM_COMMITTED),
+    )
+    m.property(
+        Expectation.SOMETIMES,
+        "abort reached",
+        exists_actor(lambda i, s: s == RM_ABORTED),
+    )
+    return m
+
+
+def __envelope(src, dst, msg):
+    from stateright_tpu.actor.network import Envelope
+
+    return Envelope(src=src, dst=dst, msg=msg)

@@ -91,13 +91,16 @@ def test_2pc_guard_conjuncts_and_kernel_agree_with_guard():
     ]
     fn = conjunct_eval_fn(t)
     rows = jnp.asarray(np.asarray(t.init_rows(), np.uint64))
-    ct = np.asarray(fn(rows))
+    leaves = [np.asarray(x) for x in fn(rows)]
     _, valid = t.step_rows(rows)
     v = np.asarray(valid)[0]
     for a in range(fp.n_actions):
         idx = cj.leaf_idx[a]
         assert idx is not None
-        assert v[a] == all(ct[0, i] for i in idx)
+        assert v[a] == all(
+            bool(leaves[j][0] if lane is None else leaves[j][0, lane])
+            for (j, lane) in idx
+        )
 
 
 def test_fieldset_top_is_conservative():

@@ -1,0 +1,615 @@
+"""Per-channel network encoding (docs/analysis.md "Per-channel encoding").
+
+Three contract families, all pinned:
+
+ - **encoding parity** — the per-channel row layout explores a state
+   space ISOMORPHIC to the slot-multiset layout: unique/total counts and
+   property verdicts are identical on every network semantics (unordered
+   non-duplicating, unordered duplicating, ordered; lossy variants), on
+   register-workload history twins (single- and multi-op) and on the
+   general fragment (timers).  The actor-form 2pc fixture
+   (``fixtures_actor.actor_2pc_model``) is the duplicating-semantics
+   exemplar — its persistent envelope set is the TLA+ message set.
+ - **real reduction** — under per-channel the independence analysis
+   decomposes the consensus twins (no JX302) and ``por()`` explores
+   STRICTLY FEWER states on paxos with identical verdicts and preserved
+   discoveries; the slot-multiset default keeps firing JX302 plus the
+   new JX305 escape-hatch pointer.
+ - **default untouched** — per-channel off leaves the compiled twin's
+   step jaxpr bit-identical and the hand-tuned paxos twin eligibility
+   unchanged (the telemetry/checked/prededup contract pattern).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fixtures_actor import actor_2pc_model
+from stateright_tpu.actor import Network
+from stateright_tpu.analysis.footprint import extract_footprints
+from stateright_tpu.analysis.independence import por_plan, run_independence
+from stateright_tpu.models.paxos import PaxosModel, PaxosState, paxos_model
+from stateright_tpu.models.paxos_tensor import PaxosTensor
+from stateright_tpu.models.raft import raft_model
+from stateright_tpu.models.single_copy_register import single_copy_model
+from stateright_tpu.models.write_once_register import wo_register_model
+from stateright_tpu.parallel.actor_compiler import (
+    CompiledActorTensor,
+    CompileError,
+    compile_actor_model,
+)
+
+# pinned per-channel paxos-1 space (3 servers, 1 client) and its
+# partial-order-reduced subset — also the CI smoke's numbers
+P1_FULL = (482, 265)
+P1_POR = (437, 250)
+# paxos-2: the full pinned 16,668-unique space and its reduced subset
+P2_FULL = (32_971, 16_668)
+P2_POR = (31_435, 16_258)
+
+
+def spawn_counts(m, caps=(1 << 15, 256), por=False):
+    b = m.checker()
+    if por:
+        b = b.por()
+    c = b.spawn_tpu(sync=True, capacity=caps[0], batch=caps[1])
+    return c
+
+
+def counts(c):
+    return (c.state_count(), c.unique_state_count(), sorted(c.discoveries()))
+
+
+def per_channel(m):
+    m.per_channel_()
+    return m
+
+
+# -- encoding parity ----------------------------------------------------------
+
+
+def test_paxos1_stepwise_parity_and_roundtrip():
+    """The strongest parity form: per state of the ENTIRE paxos-1 space,
+    the per-channel twin's device successors equal the object model's,
+    and encode/decode round-trips."""
+    from collections import deque
+
+    m = per_channel(paxos_model(1, 3))
+    t = m._tensor_cached()
+    assert isinstance(t, CompiledActorTensor)
+    assert t.network_encoding == "per-channel"
+    init = t._init_state
+    row = np.asarray(t.encode_state(init), np.uint64)
+    assert t.decode_state(row) == init
+
+    def host_succ(st):
+        out = set()
+        for act in m.actions(st):
+            ns = m.next_state(st, act)
+            if ns is not None:
+                out.add(ns)
+        return out
+
+    def dev_succ(st):
+        rows = jnp.asarray(np.asarray([t.encode_state(st)], np.uint64))
+        succ, valid = t.step_rows(rows)
+        succ, valid = np.asarray(succ), np.asarray(valid)
+        return {
+            t.decode_state(succ[0, a])
+            for a in range(valid.shape[1])
+            if valid[0, a]
+        }
+
+    seen, q = {init}, deque([init])
+    while q:
+        st = q.popleft()
+        h = host_succ(st)
+        assert h == dev_succ(st), f"successor mismatch at {st}"
+        for s2 in h:
+            if s2 not in seen:
+                seen.add(s2)
+                q.append(s2)
+    assert len(seen) == P1_FULL[1]
+
+
+def test_engine_parity_nondup_and_ordered():
+    a = counts(spawn_counts(paxos_model(1, 3)))
+    b = counts(spawn_counts(per_channel(paxos_model(1, 3))))
+    assert a == b
+    assert (b[0], b[1]) == P1_FULL
+    a = counts(spawn_counts(
+        paxos_model(1, 3, Network.new_ordered()), caps=(1 << 14, 128)
+    ))
+    b = counts(spawn_counts(
+        per_channel(paxos_model(1, 3, Network.new_ordered())),
+        caps=(1 << 14, 128),
+    ))
+    assert a == b == (178, 99, ["value chosen"])
+
+
+def test_engine_parity_duplicating_actor_2pc():
+    """The 2pc acceptance row: actor-form two-phase commit over the
+    duplicating network (TLA message-set semantics), host oracle
+    included."""
+    a = counts(spawn_counts(actor_2pc_model(3), caps=(1 << 13, 64)))
+    b = counts(spawn_counts(
+        per_channel(actor_2pc_model(3)), caps=(1 << 13, 64)
+    ))
+    assert a == b == (793, 279, ["abort reached", "commit reached"])
+    h = per_channel(actor_2pc_model(3)).checker().spawn_bfs().join()
+    assert (h.state_count(), h.unique_state_count()) == (793, 279)
+
+
+def test_engine_parity_register_history_twins():
+    """History-carrying register workloads: the multi-op codec
+    (put_count=2) and the write-once wfail path."""
+    a = counts(spawn_counts(
+        single_copy_model(2, 1, put_count=2), caps=(1 << 14, 128)
+    ))
+    b = counts(spawn_counts(
+        per_channel(single_copy_model(2, 1, put_count=2)),
+        caps=(1 << 14, 128),
+    ))
+    assert a == b == (483, 369, ["value chosen"])
+    a = counts(spawn_counts(wo_register_model(2, 1), caps=(1 << 14, 128)))
+    b = counts(spawn_counts(
+        per_channel(wo_register_model(2, 1)), caps=(1 << 14, 128)
+    ))
+    assert a == b == (97, 71, ["value chosen"])
+
+
+@pytest.mark.medium
+def test_engine_parity_lossy_variants():
+    """Lossy networks across two semantics: ordered paxos (drop advances
+    the flow) and the duplicating actor-2pc (drop is permanent)."""
+    ml = paxos_model(1, 3, Network.new_ordered())
+    ml.lossy_network(True)
+    a = counts(spawn_counts(ml, caps=(1 << 14, 128)))
+    ml2 = per_channel(paxos_model(1, 3, Network.new_ordered()))
+    ml2.lossy_network(True)
+    b = counts(spawn_counts(ml2, caps=(1 << 14, 128)))
+    assert a == b == (3167, 1150, ["value chosen"])
+    a = counts(spawn_counts(
+        actor_2pc_model(2, lossy=True), caps=(1 << 14, 128)
+    ))
+    b = counts(spawn_counts(
+        per_channel(actor_2pc_model(2, lossy=True)), caps=(1 << 14, 128)
+    ))
+    assert a == b
+    assert (a[0], a[1]) == (58_305, 11_392)
+
+
+@pytest.mark.medium
+def test_engine_parity_raft_timers_and_symmetry_composition():
+    """The general fragment with timers, plus the symmetry()+prededup()
+    composition (the PR-6 slow-tier pattern)."""
+    a = counts(spawn_counts(raft_model(3), caps=(1 << 14, 128)))
+    b = counts(spawn_counts(per_channel(raft_model(3)), caps=(1 << 14, 128)))
+    assert a == b == (15_607, 5725, ["a leader is elected"])
+    sa = raft_model(3).checker().symmetry().prededup().spawn_tpu(
+        sync=True, capacity=1 << 14, batch=128
+    )
+    sb = per_channel(raft_model(3)).checker().symmetry().prededup(
+    ).spawn_tpu(sync=True, capacity=1 << 14, batch=128)
+    assert (sa.state_count(), sa.unique_state_count()) == (7917, 2926)
+    assert (sb.state_count(), sb.unique_state_count()) == (7917, 2926)
+
+
+# -- independence: decomposition, JX305, visibility ---------------------------
+
+
+def test_per_channel_paxos_decomposes_and_jx305_names_the_escape_hatch():
+    # default slot-multiset compiled twin: JX302 + the new JX305 pointer
+    t_ms = paxos_model(1, 3)._compiled_tensor(1)
+    assert t_ms.network_encoding == "slot-multiset"
+    rep = run_independence(t_ms, list(paxos_model(1, 3).properties()))
+    rules = rep.summary()["rules"]
+    assert "JX302" in rules and "JX305" in rules
+    assert rep.summary()["encoding"] == "slot-multiset"
+    assert any(
+        "per_channel_" in f.message for f in rep.findings
+        if f.rule_id == "JX305"
+    )
+    # per-channel twin: decomposed, independent pairs, neither rule
+    m = per_channel(paxos_model(1, 3))
+    t = m._tensor_cached()
+    rep = run_independence(t, list(m.properties()))
+    s = rep.summary()
+    assert s["decomposed"] and s["encoding"] == "per-channel"
+    assert s["independent_pairs"] > 0
+    assert "JX302" not in s["rules"] and "JX305" not in s["rules"]
+    # the conflict matrix is channel-structured: deliveries from server 0
+    # to DIFFERENT servers are independent and property-invisible
+    assert not rep.visible.all()
+    plan = por_plan(t, list(m.properties()))
+    assert plan.usable
+
+
+def test_per_channel_raft_decomposes_but_stays_all_visible():
+    """raft's factored properties read every actor's state field, so the
+    matrix decomposes (no JX302) yet POR correctly falls back on the C2
+    condition — the fleet-gate contract."""
+    m = per_channel(raft_model(3))
+    t = m.tensor_model()
+    rep = run_independence(t, list(m.properties()))
+    s = rep.summary()
+    assert s["decomposed"] and s["independent_pairs"] > 0
+    assert "JX302" not in s["rules"]
+    assert bool(rep.visible.all())
+    plan = por_plan(t, list(m.properties()))
+    assert not plan.usable and "visible" in plan.fallback_reason
+
+
+def test_accum_poison_write_is_classified_not_conflicting():
+    """The saturating poison flag is an OR-accumulate: same-bit poison
+    writes alone never make two deliveries conflict (accum∩accum), but
+    the bit still counts as a write against plain writers/readers."""
+    m = per_channel(paxos_model(1, 3))
+    fp = extract_footprints(m._tensor_cached())
+    accs = [a.accum.to_json() for a in fp.actions]
+    # the non-poisoning, non-sending get_ok channel carries NO poison
+    # write at all; the put channels (table poisons) and every sending
+    # channel carry exactly the poison bit as accum
+    assert {} in accs
+    flat = [a for a in accs if a]
+    assert flat and all(len(a) == 1 for a in flat)
+
+
+# -- real reduction -----------------------------------------------------------
+
+
+def test_por_reduction_pinned_on_paxos1():
+    full = spawn_counts(per_channel(paxos_model(1, 3)))
+    por = spawn_counts(per_channel(paxos_model(1, 3)), por=True)
+    assert (full.state_count(), full.unique_state_count()) == P1_FULL
+    assert (por.state_count(), por.unique_state_count()) == P1_POR
+    assert por.unique_state_count() < full.unique_state_count()
+    assert sorted(por.discoveries()) == sorted(full.discoveries()) == [
+        "value chosen"
+    ]
+    # the discovery trace replays through the model (soundness of the
+    # reduced parent chains)
+    assert len(por.discoveries()["value chosen"].into_vec()) > 0
+    st = por.por_status()
+    assert st["enabled"] is True and st["fallback"] is None
+    assert st["encoding"] == "per-channel"
+    assert st["rows_reduced"] > 0 and st["candidates_masked"] > 0
+
+
+@pytest.mark.slow
+def test_por_reduction_pinned_on_paxos2():
+    """The headline: the full pinned 16,668-unique paxos-2 space shrinks
+    strictly under per-channel + por() with identical verdicts."""
+    full = spawn_counts(per_channel(paxos_model(2, 3)))
+    por = spawn_counts(per_channel(paxos_model(2, 3)), por=True)
+    assert (full.state_count(), full.unique_state_count()) == P2_FULL
+    assert (por.state_count(), por.unique_state_count()) == P2_POR
+    assert sorted(por.discoveries()) == sorted(full.discoveries())
+    st = por.por_status()
+    assert st["rows_reduced"] > 0 and st["encoding"] == "per-channel"
+
+
+def test_poison_detection_survives_reduction():
+    """A too-tight state_bound must fail LOUDLY under per-channel + por()
+    exactly like under full expansion: poison writes are monotone
+    OR-accumulates on the action's own read footprint, so every
+    trace-equivalent reordering the reduced search explores still takes
+    the poisoning transition (docs/analysis.md)."""
+
+    class TightPaxos(PaxosModel):
+        def tensor_model(self):
+            try:
+                return compile_actor_model(
+                    self,
+                    # ballot must reach 1 in any real run: too tight
+                    state_bound=lambda i, s: not isinstance(s, PaxosState)
+                    or s.ballot[0] <= 0,
+                    env_bound=lambda e: e.msg[0] != "internal"
+                    or e.msg[1][1][0] <= 1,
+                )
+            except (CompileError, ValueError):
+                return None
+
+    def build():
+        m = TightPaxos(
+            cfg=None,
+            init_history=paxos_model(1, 3).init_history,
+        )
+        src = paxos_model(1, 3)
+        for a in src.actors:
+            m.actor(a)
+        m.init_network_(src.init_network)
+        for p in src.properties():
+            m.property(p.expectation, p.name, p.condition)
+        m.record_msg_in(src._record_msg_in)
+        m.record_msg_out(src._record_msg_out)
+        return per_channel(m)
+
+    with pytest.raises(RuntimeError, match="poisoned rows"):
+        build().checker().spawn_tpu(sync=True, capacity=1 << 12, batch=64)
+    with pytest.raises(RuntimeError, match="poisoned rows"):
+        build().checker().por().spawn_tpu(
+            sync=True, capacity=1 << 12, batch=64
+        )
+
+
+# -- kill + resume ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_killed_and_resumed_per_channel_runs():
+    """Mid-run kill + resume under the per-channel layout: the full
+    expansion resumes to EXACT totals; under por() the resume boundary
+    legitimately re-arms one fully-expanded wavefront (the boost), so
+    the contract is verdict parity + a sound subset of the full space
+    no smaller than the reduced lattice."""
+    m = per_channel(paxos_model(2, 3))
+    c = m.checker().spawn_tpu(capacity=1 << 15, batch=256, steps_per_call=2)
+    time.sleep(0.4)
+    c.stop()
+    c.join()
+    snap = c.checkpoint()
+    r = per_channel(paxos_model(2, 3)).checker().spawn_tpu(
+        sync=True, resume=snap
+    )
+    assert (r.state_count(), r.unique_state_count()) == P2_FULL
+
+    p = per_channel(paxos_model(2, 3)).checker().por().spawn_tpu(
+        capacity=1 << 15, batch=256, steps_per_call=2
+    )
+    time.sleep(0.4)
+    p.stop()
+    p.join()
+    pr = per_channel(paxos_model(2, 3)).checker().por().spawn_tpu(
+        sync=True, resume=p.checkpoint()
+    )
+    assert sorted(pr.discoveries()) == ["value chosen"]
+    assert P2_POR[1] <= pr.unique_state_count() <= P2_FULL[1]
+
+
+# -- default path untouched ---------------------------------------------------
+
+
+def test_per_channel_off_leaves_twin_and_jaxpr_untouched():
+    # flag unset vs explicitly False: byte-identical step jaxprs, same
+    # layout, slot-multiset encoding
+    t_unset = paxos_model(1, 3)._compiled_tensor(1)
+    m_false = paxos_model(1, 3)
+    m_false.per_channel_(False)
+    t_false = m_false._compiled_tensor(1)
+    assert t_unset.network_encoding == t_false.network_encoding \
+        == "slot-multiset"
+    np.asarray(t_unset.init_rows())
+    np.asarray(t_false.init_rows())
+    aval = jax.ShapeDtypeStruct((4, t_unset.width), jnp.uint64)
+    j_unset = str(jax.make_jaxpr(t_unset.step_rows)(aval))
+    j_false = str(jax.make_jaxpr(t_false.step_rows)(aval))
+    assert j_unset == j_false
+    # the hand-tuned paxos twin stays the default; per-channel routes to
+    # the mechanical compiler
+    assert isinstance(paxos_model(2, 3).tensor_model(), PaxosTensor)
+    assert isinstance(
+        per_channel(paxos_model(2, 3)).tensor_model(), CompiledActorTensor
+    )
+
+
+def test_n_slots_is_rejected_with_per_channel():
+    m = per_channel(paxos_model(1, 3))
+    with pytest.raises(CompileError, match="slot-multiset knob"):
+        compile_actor_model(m, n_slots=32)
+
+
+def test_ordered_duplicate_ranks_poison_loudly_and_depth_knob_fixes_it():
+    """An ordered flow carrying the SAME message at two ranks outgrows a
+    default per-channel region (capacity = distinct codes): the run must
+    fail LOUDLY (overflow → poison), never silently diverge, and
+    ``per_channel_depth`` restores parity with the slot-multiset twin."""
+    from dataclasses import dataclass
+
+    from stateright_tpu import Expectation
+    from stateright_tpu.actor import Actor, ActorModel, Id, Out
+    from stateright_tpu.actor.device_props import exists_actor
+    from stateright_tpu.parallel.tensor_model import TensorBackedModel
+
+    @dataclass
+    class Resender(Actor):
+        def on_start(self, id, out):
+            if int(id) == 0:
+                out.send(Id(1), ("ping",))  # same msg TWICE: ranks 1+2
+                out.send(Id(1), ("ping",))
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            if msg[0] == "ping" and state < 2:
+                return state + 1
+            return None
+
+    def build(pc, depth=None):
+        class M(TensorBackedModel, ActorModel):
+            def tensor_model(self):
+                return compile_actor_model(
+                    self, per_channel=pc, per_channel_depth=depth
+                )
+
+        m = M(cfg=None, init_history=None)
+        m.actor(Resender())
+        m.actor(Resender())
+        m.init_network_(Network.new_ordered())
+        m.property(
+            Expectation.SOMETIMES,
+            "both delivered",
+            exists_actor(lambda i, s: s == 2),
+        )
+        return m
+
+    ms = build(False).checker().spawn_tpu(sync=True, capacity=1 << 8,
+                                          batch=8)
+    # the default per-channel capacity (1 distinct code) cannot hold the
+    # 2-deep flow: the INIT state itself refuses to encode — loud
+    with pytest.raises(ValueError, match="exceeding its region capacity"):
+        build(True).checker().spawn_tpu(sync=True, capacity=1 << 8, batch=8)
+    pc = build(True, depth=2).checker().spawn_tpu(
+        sync=True, capacity=1 << 8, batch=8
+    )
+    assert counts(ms) == counts(pc)
+
+
+# -- surfaces: por_status / run report ----------------------------------------
+
+
+def test_report_carries_por_block_with_encoding(tmp_path):
+    path = str(tmp_path / "report.json")
+    m = per_channel(paxos_model(1, 3))
+    m.checker().por().report(path).spawn_tpu(
+        sync=True, capacity=1 << 15, batch=256
+    ).join()
+    import json
+
+    body = json.load(open(path))
+    assert body["por"]["encoding"] == "per-channel"
+    assert body["por"]["enabled"] is True
+    assert body["por"]["rows_reduced"] > 0
+    md = open(path[:-5] + ".md").read()
+    assert "Partial-order reduction" in md
+    assert "per-channel" in md
+
+
+def test_regress_independence_gate_per_channel_leg():
+    """The regress.py --independence ratio-sanity gate, with injectable
+    artifacts: absent keys never trip; a well-formed leg passes; a bad
+    ratio, count inversion, wrong encoding, or crashed leg fails."""
+    from regress import independence_verdict
+
+    def clean_fleet(stream=None):
+        print("independence fleet: CLEAN", file=stream)
+        return 0
+
+    base = {
+        "tpu_paxos2_por_channel": {
+            "enabled": True, "fallback": None, "encoding": "per-channel",
+            "rows_reduced": 269, "rows_full_proviso": 387,
+            "candidates_masked": 269,
+        },
+        "tpu_paxos2_por_channel_unique": P2_POR[1],
+        "tpu_paxos2_por_channel_full_unique": P2_FULL[1],
+        "tpu_paxos2_por_channel_reduction_ratio": round(
+            P2_POR[1] / P2_FULL[1], 4
+        ),
+    }
+    # stale / pre-channel artifact: no keys, no gate
+    v = independence_verdict({}, fleet=clean_fleet)
+    assert v["clean"] and "por_channel_leg" not in v
+    # well-formed leg passes and surfaces the ratio
+    v = independence_verdict(dict(base), fleet=clean_fleet)
+    assert v["clean"] and v["por_channel_leg"]["ok"]
+    assert 0 < v["por_channel_leg"]["reduction_ratio"] <= 1
+    # ratio out of range / inconsistent
+    bad = dict(base)
+    bad["tpu_paxos2_por_channel_reduction_ratio"] = 1.7
+    assert not independence_verdict(bad, fleet=clean_fleet)["clean"]
+    # reduced > full is impossible
+    bad = dict(base)
+    bad["tpu_paxos2_por_channel_unique"] = P2_FULL[1] + 1
+    assert not independence_verdict(bad, fleet=clean_fleet)["clean"]
+    # wrong encoding
+    bad = dict(base)
+    bad["tpu_paxos2_por_channel"] = dict(
+        base["tpu_paxos2_por_channel"], encoding="slot-multiset"
+    )
+    assert not independence_verdict(bad, fleet=clean_fleet)["clean"]
+    # crashed leg
+    v = independence_verdict(
+        {"tpu_paxos2_por_channel_error": "RuntimeError: boom"},
+        fleet=clean_fleet,
+    )
+    assert not v["clean"] and not v["por_channel_leg"]["ok"]
+
+
+def test_ret_kind_envelope_to_a_server_skips_history():
+    """A put_ok RELAYED to another server must not touch the history
+    fields (the multiset kernel's `ci >= 0` guard): the per-channel
+    kernel statically skips history on non-client destinations instead
+    of tracing `h-1_*` fields."""
+    from dataclasses import dataclass
+
+    from stateright_tpu import Expectation
+    from stateright_tpu.actor import Actor, ActorModel, Id, Out
+    from stateright_tpu.actor.register import (
+        NULL_VALUE,
+        GetOk,
+        PutOk,
+        RegisterClient,
+        record_invocations,
+        record_returns,
+        value_chosen,
+    )
+    from stateright_tpu.parallel.tensor_model import TensorBackedModel
+    from stateright_tpu.semantics import LinearizabilityTester, Register
+
+    @dataclass
+    class GossipingServer(Actor):
+        value: int = NULL_VALUE
+
+        def on_start(self, id, out):
+            return NULL_VALUE
+
+        def on_msg(self, id, state, src, msg, out):
+            if msg[0] == "put" and state == NULL_VALUE:
+                out.send(src, PutOk(msg[1]))
+                out.send(Id(1), PutOk(msg[1]))  # relayed to a SERVER
+                return msg[2]
+            if msg[0] == "get" and state != NULL_VALUE:
+                out.send(src, GetOk(msg[1], state))
+                return state
+            return None
+
+    def build(pc):
+        class M(TensorBackedModel, ActorModel):
+            def tensor_model(self):
+                return compile_actor_model(self, per_channel=pc)
+
+        m = M(
+            cfg=None,
+            init_history=LinearizabilityTester(Register(NULL_VALUE)),
+        )
+        m.actor(GossipingServer())
+        m.actor(GossipingServer())
+        m.actor(RegisterClient(put_count=1, server_count=2))
+        m.init_network_(
+            Network.new_unordered_nonduplicating()
+        )
+        m.property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda model, s: s.history.is_consistent(),
+        )
+        m.property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        m.record_msg_in(record_returns)
+        m.record_msg_out(record_invocations)
+        return m
+
+    a = counts(build(False).checker().spawn_tpu(
+        sync=True, capacity=1 << 10, batch=16
+    ))
+    b = counts(build(True).checker().spawn_tpu(
+        sync=True, capacity=1 << 10, batch=16
+    ))
+    assert a == b
+
+
+def test_network_channel_helpers():
+    from stateright_tpu.actor.network import Envelope
+
+    e = Envelope(src=1, dst=2, msg=("x",))
+    assert e.channel == (1, 2)
+    n = Network.new_unordered_nonduplicating()
+    n = n.send(Envelope(0, 1, ("a",))).send(Envelope(1, 0, ("b",)))
+    n = n.send(Envelope(0, 1, ("c",)))
+    assert n.channels() == [(0, 1), (1, 0)]
+    assert Network.new_ordered().channels() == []
