@@ -138,7 +138,7 @@ _last_details = None
 # (the first four are the driver's contract and are never dropped).
 _LINE_KEYS = (
     "metric", "value", "unit", "vs_baseline",
-    "fresh", "stale", "validated_at", "error",
+    "fresh", "stale", "validated_at", "error", "regressed",
     "tpu_paxos3_states_per_sec", "tpu_paxos3_unique", "tpu_paxos3_sec",
     "cpu_baseline_states_per_sec", "cpu_baseline_src",
     "cpu_baseline_engine", "cpu_cores",
@@ -177,6 +177,46 @@ def _cpu_baseline() -> tuple:
     )
 
 
+# perf-regression guard (ADVICE item 8): a FRESH run's per-config rates
+# against the BENCH_VALIDATED.json history.  ONE tolerance with
+# regress.py's throughput gate (the r4 sweep put same-config spread
+# within ±5%, so −15% is a regression, not noise) — imported so a
+# retune there cannot silently diverge from the guard here; the
+# fallback only covers running bench.py from outside the repo root.
+try:
+    from regress import DEFAULT_TOLERANCE as REGRESS_TOLERANCE
+except ImportError:  # pragma: no cover - bench copied out of the repo
+    REGRESS_TOLERANCE = 0.85
+
+
+def _perf_regressions() -> list:
+    """Per-config ``{config, run, baseline, ratio}`` entries for every
+    freshly measured ``tpu_*_states_per_sec`` below ``REGRESS_TOLERANCE``
+    × its stored validated rate.  Compares only keys present in BOTH —
+    a carried/stale number never enters (the caller additionally gates
+    on the run being fresh), and configs the baseline never validated
+    cannot regress."""
+    out = []
+    for key, base in sorted(VALIDATED.items()):
+        if not key.endswith("_states_per_sec") or not key.startswith("tpu_"):
+            continue
+        cur = EXTRAS.get(key)
+        if (
+            not isinstance(cur, (int, float))
+            or not isinstance(base, (int, float))
+            or not base
+        ):
+            continue
+        if cur < REGRESS_TOLERANCE * base:
+            out.append({
+                "config": key,
+                "run": cur,
+                "baseline": base,
+                "ratio": round(cur / base, 3),
+            })
+    return out
+
+
 def _compute_headline() -> dict:
     """value/vs_baseline + provenance fields from EXTRAS ∪ VALIDATED.
     Returned keys OVERRIDE the raw extras in the emitted record (merge
@@ -205,6 +245,9 @@ def _compute_headline() -> dict:
             out["insert_path"] = "xla-scatter"
     if tpu_sps is not None:
         out["value"], out["fresh"] = tpu_sps, True
+        # perf-regression guard (ADVICE 8): only FRESH measurements are
+        # compared — a stale/carried artifact has nothing to regress
+        out["regressed"] = _perf_regressions()
     elif VALIDATED.get("tpu_paxos3_states_per_sec") is not None:
         # validated fallback: the stored number is evidence, not a result.
         # It rides ONLY the explicit STALE annotation — value stays 0.0 so
@@ -329,6 +372,10 @@ def record_validated() -> None:
     # number travels with its HBM footprint + growth forecast
     if EXTRAS.get("tpu_paxos3_memory"):
         doc["tpu_paxos3_memory"] = EXTRAS["tpu_paxos3_memory"]
+    # ...and the roofline block (regress.py --roofline): the validated
+    # number travels with its per-stage cost ledger + bound verdicts
+    if EXTRAS.get("tpu_paxos3_roofline"):
+        doc["tpu_paxos3_roofline"] = EXTRAS["tpu_paxos3_roofline"]
     if EXTRAS.get("tpu_phases"):
         doc["tpu_phases"] = EXTRAS["tpu_phases"]
     pallas = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
@@ -628,7 +675,7 @@ def tpu_phase() -> dict:
         # measurement arrives with its HBM footprint + growth forecast —
         # what regress.py --memory gates.
         b = m3.checker().telemetry(
-            capacity=2048, cartography=True, memory=True
+            capacity=2048, cartography=True, memory=True, roofline=True
         )
         if target:
             b = b.target_states(int(target))
@@ -650,10 +697,19 @@ def tpu_phase() -> dict:
         # a third time here
         summ3.pop("cartography", None)
         summ3.pop("memory", None)
+        summ3.pop("roofline", None)  # standalone tpu_paxos3_roofline key
         out["tpu_paxos3_telemetry"] = summ3
         mem3 = tpu_p3.memory()
         if mem3 is not None:
             out["tpu_paxos3_memory"] = mem3
+        # the roofline cost ledger (telemetry/roofline.py): the LIVE
+        # block — static per-stage FLOPs/bytes + the XLA-reconciliation
+        # verdict + achieved-vs-ceiling where a device spec is known —
+        # what regress.py --roofline gates and what the MXU round
+        # (docs/roofline.md) executes against
+        roof3 = tpu_p3.roofline()
+        if roof3 is not None:
+            out["tpu_paxos3_roofline"] = roof3
         # the per-stage attribution (init-compile / rung-compile /
         # device-step / growth / host) of the TIMED run — the numbers the
         # >=1M states/s chase is driven by (docs/perf.md)
@@ -849,7 +905,8 @@ def tpu_phase() -> dict:
         # warm-up would leave the timed run paying the cold compile
         spawn7 = lambda: (  # noqa: E731
             t7.checker()
-            .telemetry(capacity=2048, cartography=True, memory=True)
+            .telemetry(capacity=2048, cartography=True, memory=True,
+                       roofline=True)
             .spawn_tpu(sync=True, **caps7)
         )
         spawn7()  # warm-up
@@ -861,10 +918,14 @@ def tpu_phase() -> dict:
             summ7.pop("cartography", None)  # embedded as the standalone
             # tpu_2pc7_cartography key and inside the report already
             summ7.pop("memory", None)  # same rule: standalone key below
+            summ7.pop("roofline", None)  # same rule again
             out["tpu_2pc7_telemetry"] = summ7
             mem7 = tpu_t7.memory()
             if mem7 is not None:
                 out["tpu_2pc7_memory"] = mem7
+            roof7 = tpu_t7.roofline()
+            if roof7 is not None:
+                out["tpu_2pc7_roofline"] = roof7
             try:
                 from stateright_tpu.telemetry.report import build_report
 

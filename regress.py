@@ -8,7 +8,7 @@ staleness and regressions LOUD:
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
-                      [--memory] [--spill]
+                      [--memory] [--spill] [--roofline]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -401,6 +401,82 @@ def spill_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def roofline_verdict(run: dict, baseline: dict) -> dict:
+    """``--roofline``: the roofline cost-ledger section
+    (docs/roofline.md).
+
+    A FRESH run must carry a WELL-FORMED ``tpu_paxos3_roofline`` block —
+    versioned, with a non-empty per-stage map of non-negative integer
+    FLOPs/bytes whose sums reconcile against the block's own totals, and
+    an XLA-reconciliation verdict that PASSED (``reconciliation.ok``):
+    a perf number whose cost model disagrees with XLA's own analysis
+    cannot drive the MXU round.  The baseline's block is attached for
+    comparison when present but NEVER gates: stored baselines predating
+    the roofline round have none, and stale artifacts must not trip a
+    fresh run (the ``--stages``/``--cartography``/``--memory`` rule)."""
+    roof = run.get("tpu_paxos3_roofline")
+    out: dict = {"present": bool(roof)}
+    problems = []
+    if not roof:
+        problems.append("run carries no tpu_paxos3_roofline block")
+    else:
+        if not isinstance(roof.get("v"), int):
+            problems.append("missing schema version v")
+        stages = roof.get("stages")
+        totals = roof.get("totals")
+        if not isinstance(stages, dict) or not stages:
+            problems.append("stages map empty or malformed")
+        else:
+            fl_sum = by_sum = 0
+            for name, s in stages.items():
+                if not isinstance(s, dict):
+                    problems.append(f"stage {name} malformed")
+                    continue
+                for k in ("flops", "bytes_read", "bytes_written"):
+                    v = s.get(k)
+                    if not isinstance(v, int) or v < 0:
+                        problems.append(f"stage {name}.{k} missing/negative")
+                fl_sum += s.get("flops") or 0
+                by_sum += (s.get("bytes_read") or 0) + (
+                    s.get("bytes_written") or 0
+                )
+            if isinstance(totals, dict):
+                if totals.get("flops") != fl_sum:
+                    problems.append(
+                        f"sum(stage flops)={fl_sum} != totals.flops="
+                        f"{totals.get('flops')}"
+                    )
+                if totals.get("bytes") != by_sum:
+                    problems.append(
+                        f"sum(stage bytes)={by_sum} != totals.bytes="
+                        f"{totals.get('bytes')}"
+                    )
+            else:
+                problems.append("missing totals block")
+        recon = roof.get("reconciliation")
+        if not isinstance(recon, dict):
+            problems.append("missing XLA reconciliation block")
+        elif not recon.get("ok"):
+            problems.append(
+                "XLA reconciliation FAILED (analytic totals outside the "
+                "pinned tolerance bands)"
+            )
+        out["summary"] = {
+            "v": roof.get("v"),
+            "stages": sorted(stages) if isinstance(stages, dict) else [],
+            "totals": totals if isinstance(totals, dict) else None,
+            "reconciled": bool(
+                isinstance(recon, dict) and recon.get("ok")
+            ),
+            "mxu_candidates": len(roof.get("mxu_candidates") or []),
+        }
+    out["ok"] = not problems
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_paxos3_roofline"))
+    return out
+
+
 def stage_verdict(run: dict, baseline: dict) -> dict:
     """``--stages``: the per-stage attribution section (docs/perf.md).
 
@@ -435,6 +511,7 @@ def main(argv=None, fleet=None) -> int:
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
+    roofline = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -455,6 +532,8 @@ def main(argv=None, fleet=None) -> int:
             memory = True
         elif a == "--spill":
             spill = True
+        elif a == "--roofline":
+            roofline = True
         else:
             pos.append(a)
     if pos:
@@ -510,6 +589,12 @@ def main(argv=None, fleet=None) -> int:
         # crashed, or count-drifting) leg trips fresh runs only
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["spill"]["ok"]
+    if roofline:
+        verdict["roofline"] = roofline_verdict(run, baseline)
+        # same freshness rule as --stages/--cartography/--memory:
+        # stale artifacts and pre-roofline baselines never trip
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["roofline"]["ok"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -582,6 +667,18 @@ def main(argv=None, fleet=None) -> int:
             "regress: the spill leg is malformed, crashed, or drifted "
             "its counts (tpu_2pc7_spill; see stdout JSON) — a spill tier "
             "that changes counts is not a capacity tier (docs/spill.md)\n"
+        )
+        return 1
+    if (
+        "roofline" in verdict
+        and verdict["fresh"]
+        and not verdict["roofline"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: fresh run carries no (or malformed, or "
+            "non-XLA-reconciling) roofline block (tpu_paxos3_roofline) — "
+            "a perf number without its cost ledger cannot drive the MXU "
+            "round (docs/roofline.md)\n"
         )
         return 1
     return 0

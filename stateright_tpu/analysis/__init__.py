@@ -16,7 +16,11 @@ counterpart to the engines' runtime poison/growth diagnostics:
    in-place mutation, and probe one bounded step of the tabulation
    closure for unbounded (ballot-style) field domains;
  - :mod:`.audit` — the driver: twin resolution, config-drift checks, and
-   the per-model report cache.
+   the per-model report cache;
+ - :mod:`.costmodel` — the roofline cost ledger (docs/roofline.md):
+   per-op FLOPs/bytes attribution of the engine pipeline, reconciled
+   against XLA's ``cost_analysis()``, with the JX4xx MXU-candidate
+   ranking (the ``costmodel`` verb and ``.telemetry(roofline=True)``).
 
 Surfaces: ``model.checker().audit()`` (and the automatic ``spawn_tpu``
 preflight — errors abort before launch, ``skip_audit()`` overrides),
@@ -25,6 +29,7 @@ and the Explorer's ``/.status``.  Rule catalogue: ``docs/analysis.md``.
 """
 
 from .audit import audit_model, config_signature
+from .costmodel import CostReport, sharded_costs, wavefront_costs
 from .footprint import extract_footprints
 from .independence import (
     IndependenceReport,
@@ -45,6 +50,7 @@ __all__ = [
     "AuditFinding",
     "AuditReport",
     "CheckedExecutionError",
+    "CostReport",
     "IndependenceReport",
     "PorPlan",
     "Severity",
@@ -56,4 +62,6 @@ __all__ = [
     "por_plan",
     "run_independence",
     "run_sanitizer",
+    "sharded_costs",
+    "wavefront_costs",
 ]

@@ -89,6 +89,7 @@ class CheckerBuilder:
         cartography: bool = False,
         memory: bool = False,
         memory_every: int = 32,
+        roofline: bool = False,
     ) -> "CheckerBuilder":
         """Attach a flight recorder to the spawned checker
         (``stateright_tpu/telemetry/``; schema in ``docs/telemetry.md``).
@@ -124,6 +125,20 @@ class CheckerBuilder:
         either way (pinned by test, the strongest form of the contract
         below).  ``report()`` implies it.
 
+        ``roofline=True`` attaches the roofline cost ledger
+        (``telemetry/roofline.py`` + ``analysis/costmodel.py``,
+        docs/roofline.md): per-stage/per-op FLOPs-and-bytes attribution
+        of the engine pipeline, reconciled against XLA's own
+        ``cost_analysis()``, with memory-bound-vs-compute-bound stage
+        verdicts where a device spec is known
+        (``STATERIGHT_TPU_DEVICE_SPEC`` override) and the JX4xx
+        MXU-candidate ranking.  Pure host-side analysis over re-traced
+        kernels — the engine's step jaxpr stays bit-identical and the
+        engine cache unkeyed either way (the memory ledger's contract,
+        pinned by test).  Surfaces as ``checker.roofline()``, the run
+        report's ``roofline`` block, ``/.metrics``, and the
+        ``costmodel`` CLI verb.
+
         ``cartography=True`` additionally folds the search-cartography
         counters into the device step (``ops/cartography.py``,
         docs/telemetry.md): per-depth frontier sizes, the per-action
@@ -151,6 +166,9 @@ class CheckerBuilder:
         implied_mem = bool(self.telemetry_opts) and bool(
             self.telemetry_opts.get("memory")
         )
+        implied_roof = bool(self.telemetry_opts) and bool(
+            self.telemetry_opts.get("roofline")
+        )
         # a previously configured cadence is part of the sticky ledger
         # config: keep it unless this call sets one explicitly
         prev_every = (
@@ -168,6 +186,7 @@ class CheckerBuilder:
             "memory_every": int(
                 prev_every if prev_every is not None else memory_every
             ),
+            "roofline": bool(roofline) or implied_roof,
         }
         return self
 
@@ -195,6 +214,18 @@ class CheckerBuilder:
             self.telemetry()
         self.telemetry_opts["memory"] = True
         self.telemetry_opts.setdefault("memory_every", 32)
+        return self
+
+    def roofline(self, enabled: bool = True) -> "CheckerBuilder":
+        """Attach the roofline cost ledger (``telemetry/roofline.py``) —
+        a ``.telemetry(roofline=True)`` shorthand that composes with an
+        existing telemetry config instead of replacing it (the
+        ``cartography()``/``memory_ledger()`` pattern)."""
+        if not enabled:
+            return self
+        if self.telemetry_opts is None:
+            self.telemetry()
+        self.telemetry_opts["roofline"] = True
         return self
 
     def report(self, path: str) -> "CheckerBuilder":

@@ -222,6 +222,12 @@ def _metrics_view(checker) -> Optional[dict]:
         # per-tier bytes, Bloom load, deferral tallies; null unless the
         # run was spawned with .spill()
         "spill": rec.spill(),
+        # roofline cost ledger (telemetry/roofline.py, docs/roofline.md):
+        # per-stage FLOPs/bytes, op classes, reconciliation verdict,
+        # MXU-candidate ranking; null unless the run was spawned with
+        # .telemetry(roofline=True).  The UI's stage-roofline panel
+        # reads it.
+        "roofline": rec.roofline(),
     }
 
 

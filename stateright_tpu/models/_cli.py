@@ -43,6 +43,7 @@ def run_cli(
     report: Optional[Callable[[list], None]] = None,
     independence: Optional[Callable[[list], None]] = None,
     capacity: Optional[Callable[[list], None]] = None,
+    costmodel: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -74,6 +75,8 @@ def run_cli(
         independence(rest)
     elif cmd == "capacity" and capacity is not None:
         capacity(rest)
+    elif cmd == "costmodel" and costmodel is not None:
+        costmodel(rest)
     else:
         print("USAGE:")
         print(usage)
@@ -101,6 +104,10 @@ def run_cli(
         if capacity is not None:
             print("  <example> capacity [ARGS]  # HBM capacity plan: "
                   "analytic footprint per growth rung (docs/telemetry.md)")
+        if costmodel is not None:
+            print("  <example> costmodel [--out=F] [ARGS]  # roofline "
+                  "cost ledger: per-stage FLOPs/bytes, XLA "
+                  "reconciliation, MXU candidates (docs/roofline.md)")
 
 
 def pop_checked(rest: list) -> tuple:
@@ -727,6 +734,162 @@ def fleet_capacity(names: Optional[list] = None, stream=None) -> int:
     return 0 if ok else 1
 
 
+# -- costmodel verb ----------------------------------------------------------
+
+# the verb's trace/compile shapes: smaller than a default spawn so the
+# fleet gate stays seconds-per-model (the static ledger scales linearly
+# in batch — the RANKING and the reconciliation verdict are what the
+# gate checks, and both are batch-stable)
+_COSTMODEL_BATCH = 256
+_COSTMODEL_CAP = 1 << 14
+
+
+def costmodel_and_report(
+    models: Iterable[tuple], stream=None, out=None,
+) -> bool:
+    """Roofline cost ledger over ``(label, model)`` pairs
+    (``analysis/costmodel.py`` + ``telemetry/roofline.py``;
+    docs/roofline.md): per-stage FLOPs/bytes table with op classes and
+    arithmetic intensity, memory-vs-compute-bound verdicts where a
+    device spec is known (``STATERIGHT_TPU_DEVICE_SPEC``), the
+    XLA-reconciliation verdict, and the JX4xx MXU-candidate findings.
+    ``out`` collects the per-config live blocks into a JSON file (the
+    schema round-trip fixture / CI artifact).  Returns True iff every
+    twin-bearing configuration produced a well-formed, XLA-reconciling
+    ledger (twin-less models are disclosed and skipped — host checkers
+    have no device pipeline to price)."""
+    import json
+
+    from ..analysis.costmodel import wavefront_costs
+    from ..parallel.tensor_model import twin_or_none
+    from ..telemetry.memory import fmt_bytes
+    from ..telemetry.roofline import classify_stages, device_spec
+
+    stream = stream or sys.stdout
+    spec = device_spec()
+    ok = True
+    blocks = []
+    for label, model in models:
+        print(f"--- {label}", file=stream)
+        twin = twin_or_none(model)
+        if twin is None:
+            print(
+                "costmodel: no device twin for this configuration "
+                "(host checkers have no device pipeline)",
+                file=stream,
+            )
+            continue
+        try:
+            rep = wavefront_costs(
+                twin, _COSTMODEL_CAP, _COSTMODEL_CAP // 2,
+                _COSTMODEL_BATCH,
+            )
+        except Exception as e:  # noqa: BLE001 - a ledger crash is a
+            # verdict, not a crash (the capacity-verb contract)
+            ok = False
+            print(f"costmodel: ledger failed: {type(e).__name__}: {e}",
+                  file=stream)
+            continue
+        if rep is None:
+            ok = False
+            print("costmodel: twin kernels did not trace (see the "
+                  "structural audit)", file=stream)
+            continue
+        static = rep.static_block()
+        recon = rep.recon_block()
+        verdicts = classify_stages(static, spec)
+        print(
+            f"costmodel: {len(static['stages'])} stage(s), "
+            f"{static['totals']['flops']:,} FLOPs / "
+            f"{fmt_bytes(static['totals']['bytes'])} per step "
+            f"(batch {static['batch']}); XLA reconciliation: "
+            + ("ok" if recon["ok"] else "FAILED"),
+            file=stream,
+        )
+        for name, s in static["stages"].items():
+            v = verdicts.get(name, {})
+            extra = (
+                f" — {v['verdict']}"
+                if v.get("verdict") not in (None, "unknown") else ""
+            )
+            print(
+                f"  {name:>13}: {s['flops']:>12,} FLOPs  "
+                f"{fmt_bytes(s['bytes_read'] + s['bytes_written']):>9}  "
+                f"AI={s.get('intensity', '-')}" + extra,
+                file=stream,
+            )
+        for f in rep.findings:
+            print("  " + f.format(), file=stream)
+        if not recon["ok"]:
+            ok = False
+            for name, v in recon["stages"].items():
+                for p in v.get("problems", []):
+                    print(f"  RECONCILE {name}: {p}", file=stream)
+        blocks.append({
+            "label": label, **static, "reconciliation": recon,
+            **({"device_spec": spec} if spec else {}),
+            "verdicts": verdicts,
+        })
+    if out:
+        with open(out, "w") as f:
+            json.dump({"v": blocks[0]["v"] if blocks else 1,
+                       "configs": blocks}, f, indent=1)
+            f.write("\n")
+    return ok
+
+
+def make_costmodel_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
+    """Wrap a ``rest -> [(label, model), ...]`` factory as a
+    ``costmodel`` CLI verb (``--out=F`` collects the JSON blocks; exit 1
+    on a malformed or non-reconciling ledger)."""
+
+    def _costmodel(rest: list) -> None:
+        out, _chrome, rest = _split_profile_args(rest, default_out="")
+        if not costmodel_and_report(factory(rest), out=out or None):
+            print("costmodel: FAILED")
+            raise SystemExit(1)
+
+    return _costmodel
+
+
+def fleet_costmodel(args: Optional[list] = None, stream=None) -> int:
+    """Roofline-cost-ledger the whole example fleet (or just the named
+    modules); 0 iff every twin-bearing configuration produced a
+    well-formed, XLA-reconciling ledger.  Same coverage contract as the
+    other fleet gates: a module without ``_audit_models`` fails."""
+    import importlib
+
+    from . import __all__ as all_names
+
+    stream = stream or sys.stdout
+    out, _chrome, names = _split_profile_args(list(args or []),
+                                              default_out="")
+    ok = True
+    blocks_out = out or None
+    for name in names or list(all_names):
+        mod = importlib.import_module(f"stateright_tpu.models.{name}")
+        factory = getattr(mod, "_audit_models", None)
+        if factory is None:
+            print(
+                f"--- {name}: FAILED — no _audit_models hook (add one so "
+                "the fleet gate covers this example)",
+                file=stream,
+            )
+            ok = False
+            continue
+        # one --out file per module would clobber; the fleet gate
+        # appends the module name when an out path is given
+        mod_out = None
+        if blocks_out:
+            stem, ext = os.path.splitext(blocks_out)
+            mod_out = f"{stem}-{name}{ext or '.json'}"
+        ok = costmodel_and_report(
+            factory([]), stream=stream, out=mod_out
+        ) and ok
+    print("costmodel fleet: " + ("OK" if ok else "FAILED"), file=stream)
+    return 0 if ok else 1
+
+
 # -- profile verb ------------------------------------------------------------
 
 
@@ -957,6 +1120,8 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_report(argv[1:]))
     if argv and argv[0] == "capacity":
         raise SystemExit(fleet_capacity(argv[1:]))
+    if argv and argv[0] == "costmodel":
+        raise SystemExit(fleet_costmodel(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
@@ -978,6 +1143,11 @@ def main(argv: Optional[list] = None) -> None:
     print("  python -m stateright_tpu.models._cli capacity [MODULE...]")
     print("    HBM capacity plan over the fleet: analytic per-rung "
           "footprint + max reachable states (docs/telemetry.md)")
+    print("  python -m stateright_tpu.models._cli costmodel [--out=F] "
+          "[MODULE...]")
+    print("    roofline cost ledger over the fleet: per-stage "
+          "FLOPs/bytes, XLA reconciliation, MXU candidates "
+          "(docs/roofline.md); exit 1 on a non-reconciling ledger")
 
 
 if __name__ == "__main__":

@@ -43,6 +43,7 @@ from ._cli import (
     make_audit_cmd,
     make_profile_cmd,
     make_capacity_cmd,
+    make_costmodel_cmd,
     make_report_cmd,
     make_independence_cmd,
     make_sanitize_cmd,
@@ -388,6 +389,7 @@ def main(argv=None):
         profile=make_profile_cmd(_audit_models),
         report=make_report_cmd(_audit_models),
         capacity=make_capacity_cmd(_audit_models),
+        costmodel=make_costmodel_cmd(_audit_models),
         argv=argv,
     )
 

@@ -223,6 +223,30 @@ class WavefrontChecker(Checker):
                 every=int(self._telemetry_opts.get("memory_every") or 0),
                 extra=self._memory_extra(),
             )
+        # roofline cost ledger (telemetry/roofline.py +
+        # analysis/costmodel.py): per-stage/per-op FLOPs-bytes
+        # attribution, XLA-reconciled, with the JX4xx MXU-candidate
+        # ranking.  Pure host analysis over RE-TRACED kernels — the
+        # engine's own step program is untouched and the engine cache
+        # unkeyed either way (pinned by test, the memory ledger's
+        # contract).  Built eagerly here (one small trace + compile per
+        # pipeline stage, cached on the twin) so the snapshot exists
+        # before the first poll.
+        self._roofline_ledger = None
+        if (
+            self.flight_recorder is not None
+            and self._telemetry_opts.get("roofline")
+        ):
+            from ..telemetry.roofline import RooflineLedger
+
+            try:
+                self._roofline_ledger = RooflineLedger(
+                    tag,
+                    self._roofline_cost_fn(),
+                    recorder=self.flight_recorder,
+                )
+            except Exception:  # noqa: BLE001 - accounting must never
+                self._roofline_ledger = None  # break a run
         # preflight capacity guard: cheap analytic math, always on (warn;
         # STATERIGHT_TPU_CAPACITY_GUARD=error escalates, =off silences) —
         # a run whose requested table cannot fit the device should say so
@@ -338,6 +362,30 @@ class WavefrontChecker(Checker):
             total,
             warn_once_obj=self.model,
         )
+
+    def _roofline_cost_fn(self):
+        """Zero-arg ``() -> CostReport | None`` analytic cost model at
+        this engine's capacities; engine-specific."""
+        raise NotImplementedError
+
+    def roofline(self, live: bool = True) -> Optional[dict]:
+        """Latest roofline-ledger block (``telemetry/roofline.py``), or
+        None when the run was spawned without
+        ``.telemetry(roofline=True)`` (or the twin's kernels did not
+        trace).  ``live=False`` returns the DETERMINISTIC static subset
+        (the run report's ``roofline`` block: analytic costs only — no
+        XLA numbers, no device spec, no wall clock); the default adds
+        the reconciliation verdict, per-stage memory/compute-bound
+        verdicts, and — once stage attribution exists — the
+        achieved-vs-ceiling estimate."""
+        led = self._roofline_ledger
+        if led is None or not led.ok:
+            return None
+        if not live:
+            return led.static_block()
+        rec = self.flight_recorder
+        stages = rec.stages() if rec is not None else None
+        return led.live_block(stages, self.unique_state_count())
 
     def memory(self, live: bool = True) -> Optional[dict]:
         """Latest memory-ledger snapshot (``telemetry/memory.py``), or

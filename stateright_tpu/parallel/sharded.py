@@ -757,6 +757,25 @@ class ShardedTpuChecker(WavefrontChecker):
             "frontier_capacity": self._fcap_local * self.ndev,
         }
 
+    def _roofline_cost_fn(self):
+        """Model-kernel cost ledger (``costmodel.sharded_costs``):
+        property/expand/hash at the per-device frontier width.  The
+        mesh insert + all-to-all are collectives the single-kernel walk
+        cannot price honestly — they land with the pod-scale mesh round
+        (ROADMAP); the block's ``engine: sharded`` tag says so."""
+        from ..analysis.costmodel import sharded_costs
+
+        tensor = self.tensor
+        cap_local, fcap_local = self._cap_local, self._fcap_local
+        ndev, sym = self.ndev, self._symmetry is not None
+
+        def cost_fn():
+            return sharded_costs(
+                tensor, cap_local, fcap_local, ndev, sym=sym,
+            )
+
+        return cost_fn
+
     def _cart_zero_host(self) -> list:
         """Fresh host-side cartography counter buffers in carry-tail order
         (depth/action/property tallies + per-shard load and route matrix);

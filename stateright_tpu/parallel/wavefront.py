@@ -1021,6 +1021,23 @@ class TpuChecker(WavefrontChecker):
     def _memory_caps(self) -> dict:
         return {"cap": self._cap, "qcap": self._qcap, "batch": self._batch}
 
+    def _roofline_cost_fn(self):
+        """Analytic pipeline cost model at THIS engine's spawn
+        capacities (``analysis/costmodel.wavefront_costs``, cached on
+        the twin) — the roofline ledger's data source."""
+        from ..analysis.costmodel import wavefront_costs
+
+        tensor = self.tensor
+        cap, qcap, batch = self._cap, self._qcap, self._batch
+        cand, sym = self._cand, self._symmetry is not None
+
+        def cost_fn():
+            return wavefront_costs(
+                tensor, cap, qcap, batch, cand, sym=sym,
+            )
+
+        return cost_fn
+
     def _memory_extra(self) -> dict:
         return {"queue_capacity": self._qcap}
 

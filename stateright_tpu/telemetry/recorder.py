@@ -7,7 +7,7 @@ Record schema (every record):
  - ``t``    — seconds since the recorder was created (monotonic clock)
  - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
    | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"memory"``
-   | ``"note"``
+   | ``"roofline"`` | ``"note"``
 
 ``step`` records additionally carry the engine tag and cumulative counters
 (``states``, ``unique``) plus derived per-step deltas (``d_states``,
@@ -94,6 +94,10 @@ class FlightRecorder:
         # discipline again; the engines refresh it per eviction /
         # resolution / sync
         self._spill: Optional[dict] = None
+        # latest roofline-ledger snapshot (telemetry/roofline.py):
+        # static per-stage FLOPs/bytes + reconciliation + verdicts;
+        # set once at spawn (the static model cannot change mid-run)
+        self._roofline: Optional[dict] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -231,6 +235,19 @@ class FlightRecorder:
         with self._lock:
             return dict(self._spill) if self._spill else None
 
+    def set_roofline(self, snap: dict) -> None:
+        """Replace the roofline-ledger snapshot (``telemetry/roofline.py``:
+        per-stage FLOPs/bytes, op classes, MXU-candidate ranking,
+        XLA-reconciliation verdict)."""
+        with self._lock:
+            self._roofline = dict(snap)
+
+    def roofline(self) -> Optional[dict]:
+        """Latest roofline snapshot, or None when the run was spawned
+        without ``.telemetry(roofline=True)``."""
+        with self._lock:
+            return dict(self._roofline) if self._roofline else None
+
     def set_spill_armed(self, armed: bool = True) -> None:
         """Tell the health model the spill tier is armed: the
         ``growth_oom_risk`` condition downgrades to the informational
@@ -358,6 +375,7 @@ class FlightRecorder:
             )
             memory = dict(self._memory) if self._memory else None
             spill = dict(self._spill) if self._spill else None
+            roofline = dict(self._roofline) if self._roofline else None
         occ = [r for r in recs if r["kind"] == "occupancy"]
         out: dict = {
             **meta,
@@ -396,6 +414,8 @@ class FlightRecorder:
             out["memory"] = memory
         if spill is not None:
             out["spill"] = spill
+        if roofline is not None:
+            out["roofline"] = roofline
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")
@@ -427,6 +447,8 @@ class FlightRecorder:
                 self._memory = dict(summary["memory"])
             if summary.get("spill") and self._spill is None:
                 self._spill = dict(summary["spill"])
+            if summary.get("roofline") and self._roofline is None:
+                self._roofline = dict(summary["roofline"])
             if summary.get("states") is not None and self._last_step:
                 last_t = self._last_step[0]
                 self._last_step = (

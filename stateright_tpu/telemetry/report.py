@@ -97,6 +97,17 @@ def build_report(checker) -> dict:
         mem = mem_fn(live=False)
         if mem is not None:
             out["memory"] = mem
+    # roofline cost ledger (telemetry/roofline.py, docs/roofline.md):
+    # the DETERMINISTIC static block only — per-stage analytic
+    # FLOPs/bytes, op classes, per-action attribution, MXU-candidate
+    # ranking.  XLA reconciliation numbers (backend-specific) and the
+    # device spec / wall-clock ceilings stay OUT of the JSON body; the
+    # markdown rendering carries them instead.
+    roof_fn = getattr(checker, "roofline", None)
+    if callable(roof_fn):
+        roof = roof_fn(live=False)
+        if roof is not None:
+            out["roofline"] = roof
     # partial-order reduction (docs/analysis.md): the network encoding in
     # use, the fallback reason when reduction is off, and the
     # reduced-vs-full tallies — count-derived for a fixed model/config,
@@ -173,11 +184,14 @@ def _hist_lines(values, label_of) -> list:
     ]
 
 
-def render_markdown(report: dict, rec=None) -> str:
+def render_markdown(report: dict, rec=None, roofline_live=None) -> str:
     """Human rendering of a report body.  ``rec`` (the run's live
     FlightRecorder) adds the WALL-CLOCK section — stage attribution and
     throughput — which is deliberately absent from the JSON body (it
-    varies run to run; docs/telemetry.md "Reading a run report")."""
+    varies run to run; docs/telemetry.md "Reading a run report").
+    ``roofline_live`` (``checker.roofline()``'s default view) adds the
+    achieved-vs-ceiling roofline estimate; falls back to the recorder's
+    spawn-time snapshot (spec + verdicts, no achieved block)."""
     t = report.get("totals", {})
     lines = [
         f"# Run report — {report.get('model')} ({report.get('engine')})",
@@ -258,6 +272,37 @@ def render_markdown(report: dict, rec=None) -> str:
             lines.append(
                 "- largest buffers: "
                 + ", ".join(f"{k}={fmt_bytes(v)}" for k, v in top)
+            )
+    roof = report.get("roofline")
+    if roof:
+        from .memory import fmt_bytes
+
+        lines += ["", "## Roofline (static cost model)", ""]
+        lines.append(
+            f"- per-step analytic totals: **{roof['totals'].get('flops'):,}"
+            f" FLOPs**, **{fmt_bytes(roof['totals'].get('bytes'))} moved**"
+            + (
+                f" (intensity {roof['totals']['intensity']} FLOPs/byte)"
+                if roof["totals"].get("intensity") is not None else ""
+            )
+        )
+        lines += ["", "| stage | FLOPs | bytes | intensity | top class |",
+                  "|---|---|---|---|---|"]
+        for name, s in (roof.get("stages") or {}).items():
+            classes = s.get("classes") or {}
+            top = max(
+                classes, key=lambda k: classes[k]["bytes"], default="-"
+            ) if classes else "-"
+            lines.append(
+                f"| {name} | {s.get('flops'):,} | "
+                f"{fmt_bytes(s.get('bytes_read', 0) + s.get('bytes_written', 0))}"
+                f" | {s.get('intensity', '-')} | {top} |"
+            )
+        for c in (roof.get("mxu_candidates") or [])[:4]:
+            lines.append(
+                f"- MXU candidate #{c['rank']}: `{c['op']}` in "
+                f"`{c['stage']}` moving {fmt_bytes(c['bytes'])}/step "
+                f"({c['rule']})"
             )
     por = report.get("por")
     if por:
@@ -377,6 +422,44 @@ def render_markdown(report: dict, rec=None) -> str:
         if stages:
             for k, v in stages.items():
                 lines.append(f"- {k}: {v}")
+        # the roofline's wall-clock half (telemetry/roofline.py):
+        # achieved-vs-ceiling estimates + per-stage bound verdicts —
+        # device-spec- and machine-dependent, so markdown only, never
+        # the deterministic JSON body
+        roofl = roofline_live or (
+            rec.roofline() if hasattr(rec, "roofline") else None
+        )
+        if roofl:
+            spec = roofl.get("device_spec")
+            if spec:
+                lines.append(
+                    f"- roofline device spec: {spec.get('name')} "
+                    f"(peak {spec.get('peak_flops'):.3g} FLOP/s, HBM "
+                    f"{spec.get('hbm_bytes_per_sec'):.3g} B/s, ridge "
+                    f"{spec.get('ridge'):.2f} FLOPs/byte; "
+                    f"{spec.get('src')})"
+                )
+            verdicts = roofl.get("verdicts") or {}
+            bound = [
+                f"{k}={v['verdict']}" for k, v in verdicts.items()
+                if v.get("verdict") != "unknown"
+            ]
+            if bound:
+                lines.append("- stage roofline verdicts: " + ", ".join(bound))
+            ach = roofl.get("achieved")
+            if ach:
+                bits = [
+                    f"{ach['bytes_per_sec']:.3g} B/s",
+                    f"{ach['flops_per_sec']:.3g} FLOP/s",
+                ]
+                if ach.get("frac_of_hbm_ceiling") is not None:
+                    bits.append(
+                        f"{100 * ach['frac_of_hbm_ceiling']:.2f}% of the "
+                        "HBM ceiling"
+                    )
+                lines.append(
+                    "- achieved (est., device time): " + ", ".join(bits)
+                )
         live = rec.memory() if hasattr(rec, "memory") else None
         if live and (live.get("device") or live.get("budget_bytes")):
             from .memory import fmt_bytes
@@ -426,6 +509,8 @@ def write_report(checker, path: str) -> dict:
         f.write("\n")
     md_path = os.path.splitext(path)[0] + ".md"
     rec = getattr(checker, "flight_recorder", None)
+    roof_fn = getattr(checker, "roofline", None)
+    roofline_live = roof_fn() if callable(roof_fn) else None
     with open(md_path, "w") as f:
-        f.write(render_markdown(body, rec=rec))
+        f.write(render_markdown(body, rec=rec, roofline_live=roofline_live))
     return body

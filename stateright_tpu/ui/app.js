@@ -219,6 +219,52 @@ function renderMemory(mem, health) {
   }
 }
 
+// ------------------------------------------------------------ roofline --
+// Stage-roofline panel over the /.metrics roofline block
+// (telemetry/roofline.py): per-stage bytes/step bars, intensity +
+// memory/compute-bound verdicts where a device spec is known, the
+// XLA-reconciliation verdict, and the top MXU candidate (JX4xx).
+function renderRoofline(roof) {
+  const panel = $("roofline");
+  if (!roof) {
+    panel.hidden = true;
+    return;
+  }
+  panel.hidden = false;
+  const names = Object.keys(roof.stages || {});
+  const bytes = names.map(
+    (n) => roof.stages[n].bytes_read + roof.stages[n].bytes_written
+  );
+  barchart($("hist-roof"), bytes);
+  $("roof-bytes-n").textContent =
+    "· " + fmtBytes(bytes.reduce((a, b) => a + b, 0)) + "/step";
+  const ul = $("roof-stages");
+  ul.innerHTML = "";
+  names.forEach((n) => {
+    const s = roof.stages[n];
+    const v = (roof.verdicts || {})[n] || {};
+    const li = document.createElement("li");
+    li.textContent =
+      n + ": " + fmtBytes(s.bytes_read + s.bytes_written) +
+      ", " + s.flops.toLocaleString() + " FLOPs" +
+      (s.intensity !== undefined ? ", AI=" + s.intensity.toFixed(3) : "") +
+      (v.verdict && v.verdict !== "unknown" ? " — " + v.verdict : "");
+    ul.appendChild(li);
+  });
+  const bits = [];
+  if (roof.reconciliation)
+    bits.push("XLA-reconciled=" + (roof.reconciliation.ok ? "ok" : "FAIL"));
+  if (roof.device_spec)
+    bits.push("spec=" + roof.device_spec.name);
+  const top = (roof.mxu_candidates || [])[0];
+  if (top)
+    bits.push(
+      "top MXU candidate: " + top.op + " in " + top.stage +
+      " (" + fmtBytes(top.bytes) + "/step)"
+    );
+  $("roof-summary").textContent = bits.join("  ") || "—";
+}
+
 function renderHealth(h) {
   const el = $("health-line");
   if (!h) {
@@ -268,6 +314,7 @@ async function pollMetrics() {
     renderHealth(m.health);
     renderCartography(m.cartography);
     renderMemory(m.memory, m.health);
+    renderRoofline(m.roofline);
   } catch (e) {
     /* transient; retry next poll */
   }

@@ -77,6 +77,67 @@ def test_emit_clear_removes_stale_error(bench_env, capsys):
     assert out[1]["vs_baseline"] == 20.0
 
 
+def test_perf_regression_guard_flags_fresh_slowdowns(bench_env, capsys):
+    """ADVICE item 8: a FRESH run whose per-config states/s fall below
+    REGRESS_TOLERANCE x the stored validated history emits a
+    ``regressed: [...]`` entry naming the config, both rates, and the
+    ratio; configs at/above tolerance (and configs the baseline never
+    validated) stay out."""
+    b = _load_bench()
+    b.VALIDATED.update({
+        "tpu_paxos3_states_per_sec": 266_699.0,
+        "tpu_2pc7_states_per_sec": 100_000.0,
+        "validated_at": "2025-01-01T00:00:00Z",
+    })
+    b.emit(
+        tpu_paxos3_states_per_sec=100_000.0,  # 0.375x: regression
+        tpu_2pc7_states_per_sec=99_000.0,  # 0.99x: within tolerance
+        tpu_2pc4_states_per_sec=50.0,  # never validated: cannot regress
+    )
+    line = _lines(capsys)[-1]
+    assert line["fresh"] is True
+    (entry,) = line["regressed"]
+    assert entry["config"] == "tpu_paxos3_states_per_sec"
+    assert entry["run"] == 100_000.0
+    assert entry["baseline"] == 266_699.0
+    assert entry["ratio"] == round(100_000.0 / 266_699.0, 3)
+    details = json.load(open(os.environ["BENCH_DETAILS_FILE"]))
+    assert details["regressed"] == [entry]
+
+
+def test_perf_regression_guard_never_trips_on_stale_runs(bench_env, capsys):
+    """The guard compares MEASUREMENTS: a dead-tunnel run that only
+    replays the validated number (fresh: false, value 0.0) emits no
+    ``regressed`` field at all — a carried number cannot regress
+    against itself."""
+    b = _load_bench()
+    b.VALIDATED.update({
+        "tpu_paxos3_states_per_sec": 266_699.0,
+        "validated_at": "2025-01-01T00:00:00Z",
+    })
+    b.emit(cpu_paxos3_states_per_sec=8000.0)  # no fresh TPU number
+    line = _lines(capsys)[-1]
+    assert line["fresh"] is False and line["value"] == 0.0
+    assert "regressed" not in line
+    details = json.load(open(os.environ["BENCH_DETAILS_FILE"]))
+    assert "regressed" not in details
+
+
+def test_perf_regression_guard_clean_run_emits_empty_list(bench_env, capsys):
+    """A fresh run at/above tolerance still carries the field — an empty
+    list says the guard RAN and found nothing, distinct from a stale
+    run where it never ran."""
+    b = _load_bench()
+    b.VALIDATED.update({
+        "tpu_paxos3_states_per_sec": 100_000.0,
+        "validated_at": "2025-01-01T00:00:00Z",
+    })
+    b.emit(tpu_paxos3_states_per_sec=99_000.0)
+    line = _lines(capsys)[-1]
+    assert line["fresh"] is True
+    assert line["regressed"] == []
+
+
 def test_emit_prefers_winning_insert_path(bench_env, capsys):
     b = _load_bench()
     b.emit(

@@ -478,3 +478,96 @@ def test_memory_section_gates_fresh_runs_only(tmp_path, capsys):
     base.write_text(json.dumps({**BASELINE, "tpu_paxos3_memory": mem}))
     rc, v = run(good, "--memory")
     assert rc == 0 and v["memory"]["baseline_present"] is True
+
+
+def test_roofline_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--roofline: a FRESH run must carry a well-formed roofline block
+    (versioned, per-stage non-negative integer FLOPs/bytes summing to
+    the totals, a PASSING XLA-reconciliation verdict); stored baselines
+    without one (pre-roofline rounds) never trip, staleness still exits
+    2 — the --stages/--cartography/--memory rule applied to the cost
+    ledger (docs/roofline.md)."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # note: baseline has no block
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    roof = {
+        "v": 1,
+        "engine": "wavefront",
+        "batch": 4096,
+        "stages": {
+            "expand": {"flops": 1000, "bytes_read": 2000,
+                       "bytes_written": 500},
+            "dedup-insert": {"flops": 4000, "bytes_read": 8000,
+                             "bytes_written": 1500},
+        },
+        "totals": {"flops": 5000, "bytes": 12000},
+        "mxu_candidates": [{"rank": 1, "stage": "dedup-insert",
+                            "op": "gather", "bytes": 6000}],
+        "reconciliation": {"ok": True, "stages": {}},
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_paxos3_roofline": roof}
+    # fresh + well-formed + reconciled -> ok; absent baseline is fine
+    rc, v = run(good, "--roofline")
+    assert rc == 0 and v["ok"] is True
+    assert v["roofline"]["ok"] is True
+    assert v["roofline"]["baseline_present"] is False
+    assert v["roofline"]["summary"]["reconciled"] is True
+    assert v["roofline"]["summary"]["mxu_candidates"] == 1
+    # fresh but NO block -> exit 1, named in the verdict
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0},
+                "--roofline")
+    assert rc == 1 and v["roofline"]["ok"] is False
+    assert any("no tpu_paxos3_roofline" in p
+               for p in v["roofline"]["problems"])
+    # malformed: stage sums disagree with the totals
+    rc, v = run({**good,
+                 "tpu_paxos3_roofline": {
+                     **roof, "totals": {"flops": 1, "bytes": 12000},
+                 }}, "--roofline")
+    assert rc == 1
+    assert any("totals.flops" in p for p in v["roofline"]["problems"])
+    # malformed: negative stage bytes
+    rc, v = run({**good,
+                 "tpu_paxos3_roofline": {
+                     **roof,
+                     "stages": {"expand": {"flops": 1, "bytes_read": -5,
+                                           "bytes_written": 0}},
+                 }}, "--roofline")
+    assert rc == 1
+    assert any("missing/negative" in p for p in v["roofline"]["problems"])
+    # a FAILED XLA reconciliation is a gate failure, not a note
+    rc, v = run({**good,
+                 "tpu_paxos3_roofline": {
+                     **roof, "reconciliation": {"ok": False},
+                 }}, "--roofline")
+    assert rc == 1
+    assert any("reconciliation FAILED" in p
+               for p in v["roofline"]["problems"])
+    # unversioned -> exit 1
+    rc, v = run({**good,
+                 "tpu_paxos3_roofline": {
+                     k: x for k, x in roof.items() if k != "v"
+                 }}, "--roofline")
+    assert rc == 1
+    assert any("schema version" in p for p in v["roofline"]["problems"])
+    # stale run: staleness exits 2 regardless of the roofline gate
+    rc, v = run({"fresh": False}, "--roofline")
+    assert rc == 2
+    # --allow-stale: a stored pre-roofline artifact is reported, not gated
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0},
+                "--roofline", "--allow-stale")
+    assert rc == 0 and v["roofline"]["ok"] is False
+    # baseline WITH a block is noted for comparison
+    base.write_text(json.dumps({**BASELINE, "tpu_paxos3_roofline": roof}))
+    rc, v = run(good, "--roofline")
+    assert rc == 0 and v["roofline"]["baseline_present"] is True

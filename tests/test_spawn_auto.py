@@ -99,6 +99,50 @@ def test_visitor_large_space_escalates_to_mp(monkeypatch):
     assert len(seen) == 8832
 
 
+def test_visitor_escalation_defers_visits_to_run_end(monkeypatch):
+    """ADVICE item 6 — the visitor-timing hole, pinned: when a visitor
+    run escalates to mp-BFS, the callbacks are DEFERRED TO RUN END.
+    Worker processes record per-round visit orders (fingerprints only —
+    callbacks cannot cross the fork boundary) and the PARENT replays
+    them round-major through the visitor only after every worker joined
+    and the parent map merged, so each callback sees a complete,
+    reconstructable path and the replay is a valid BFS level order.
+    Callers needing LIVE per-state visits (progress bars, streaming
+    consumers) should stay on the thread engine — spawn_bfs() — where
+    visits interleave with exploration; this is the documented
+    behavior, not a bug (docs/telemetry.md "Visitors and engines")."""
+    import os
+
+    from stateright_tpu.checker import mp as mp_mod
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    at_replay = {}
+    orig = mp_mod.MpBfsChecker._replay_visits
+
+    def spy(self, visitor, results):
+        # the moment callbacks start: the merged space must already be
+        # COMPLETE (deferred-to-run-end, not live)
+        at_replay["unique"] = len(self._generated)
+        at_replay["count"] = self._count
+        return orig(self, visitor, results)
+
+    monkeypatch.setattr(mp_mod.MpBfsChecker, "_replay_visits", spy)
+    depths = []
+    c = (
+        TwoPhaseSys(5)
+        .checker()
+        .visitor(lambda model, path: depths.append(len(path.into_vec())))
+        .spawn_auto(probe_secs=0.01)
+    )
+    assert isinstance(c, mp_mod.MpBfsChecker)
+    # visits began only after the full space was merged...
+    assert at_replay["unique"] == 8832
+    # ...fired exactly once per unique state...
+    assert len(depths) == 8832
+    # ...in round-major replay order = a valid BFS level order
+    assert depths == sorted(depths)
+
+
 def test_symmetry_probe_uses_dfs():
     """With ``symmetry()`` the CPU probe is DFS (the host engine that
     supports representative dedup, as in the reference where symmetry is

@@ -390,7 +390,7 @@ def test_explorer_metrics_endpoint_shape():
         m = _get(server.addr, "/.metrics")
         assert sorted(m) == [
             "cartography", "counters", "health", "memory", "occupancy",
-            "series", "spill", "summary",
+            "roofline", "series", "spill", "summary",
         ]
         series = m["series"]
         assert sorted(series) == [
@@ -406,6 +406,7 @@ def test_explorer_metrics_endpoint_shape():
         # memory=True), never fabricated
         assert m["cartography"] is None
         assert m["memory"] is None
+        assert m["roofline"] is None
         # the health snapshot is always present with telemetry on
         assert m["health"]["phase"] == "done"
         assert m["health"]["stalled"] is False

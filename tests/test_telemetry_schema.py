@@ -93,6 +93,17 @@ SCHEMA = {
          "pending": int, "dups": int, "novel": int,
          "rows": int, "host_rows": int},
     ),
+    "roofline": (
+        # the roofline cost ledger's spawn-time record
+        # (telemetry/roofline.py): per-stage analytic FLOPs/bytes +
+        # totals + the XLA-reconciliation verdict.  Emitted once at
+        # init — the static model cannot change mid-run.
+        {
+            "v": int, "at": str, "engine": str, "stages": dict,
+            "totals": dict, "reconciled": bool,
+        },
+        {},
+    ),
     "memory": (
         # the HBM ledger's per-rung snapshot (telemetry/memory.py):
         # per-buffer analytic bytes + the growth-transient forecast;
@@ -176,14 +187,15 @@ def test_every_exported_record_matches_the_golden_schema(tmp_path):
     lines = _export_lines(
         tmp_path,
         TwoPhaseSys(5).checker().telemetry(
-            occupancy_every=2, cartography=True, memory=True
+            occupancy_every=2, cartography=True, memory=True,
+            roofline=True,
         ),
         capacity=1 << 10, batch=256,  # tiny: forces growth events
     )
     records = [ln for ln in lines if ln.get("kind") != "header"]
     kinds = {r["kind"] for r in records}
     for expect in ("step", "growth", "occupancy", "compile", "health",
-                   "cartography", "memory"):
+                   "cartography", "memory", "roofline"):
         assert expect in kinds, f"run did not exercise {expect!r} records"
     problems = []
     for r in records:
@@ -255,6 +267,52 @@ def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
         sorted(p) == ["condition_hits", "evaluated", "name"]
         for p in props
     )
+
+
+def test_summary_roofline_block_matches_report_block_shape(tmp_path):
+    """The summary's embedded roofline block is the live-snapshot shape
+    (static block + reconciliation/verdicts): the per-stage map and the
+    totals parse with the same reader as the run report's block."""
+    lines = _export_lines(
+        tmp_path,
+        TwoPhaseSys(3).checker().telemetry(roofline=True),
+        capacity=1 << 12, batch=64,
+    )
+    roof = lines[0]["summary"]["roofline"]
+    assert isinstance(roof["v"], int)
+    assert isinstance(roof["stages"], dict) and roof["stages"]
+    for s in roof["stages"].values():
+        for k in ("flops", "bytes_read", "bytes_written"):
+            assert isinstance(s[k], int) and s[k] >= 0
+    assert roof["totals"]["flops"] == sum(
+        s["flops"] for s in roof["stages"].values()
+    )
+    assert roof["reconciliation"]["ok"] is True
+
+
+def test_costmodel_verb_out_round_trips(tmp_path):
+    """The ``costmodel`` verb's ``--out=`` fixture: the written JSON
+    parses back into versioned per-config blocks whose stage maps and
+    totals satisfy the regress gate's well-formedness rules."""
+    from stateright_tpu.models import two_phase_commit
+
+    out = tmp_path / "costmodel.json"
+    two_phase_commit.main(["costmodel", f"--out={out}"])
+    doc = json.loads(out.read_text())
+    assert isinstance(doc["v"], int)
+    assert doc["configs"], "no config blocks written"
+    for blk in doc["configs"]:
+        assert isinstance(blk["label"], str)
+        assert isinstance(blk["stages"], dict) and blk["stages"]
+        assert blk["totals"]["flops"] == sum(
+            s["flops"] for s in blk["stages"].values()
+        )
+        assert blk["totals"]["bytes"] == sum(
+            s["bytes_read"] + s["bytes_written"]
+            for s in blk["stages"].values()
+        )
+        assert blk["reconciliation"]["ok"] is True
+        assert isinstance(blk["mxu_candidates"], list)
 
 
 def test_summary_memory_block_matches_snapshot_schema(tmp_path):
