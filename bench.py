@@ -50,6 +50,18 @@ breakdown in ``tpu_2pc7_spill``); ``regress.py --spill`` gates its
 well-formedness and count parity.  ``BENCH_SPILL_BUDGET`` overrides
 the computed budget.
 
+Run ledger (docs/telemetry.md "Comparing runs"): with
+``STATERIGHT_TPU_RUN_DIR`` set, EVERY device leg bench runs is archived
+into the persistent run registry (``telemetry/registry.py``) — one
+report + ``config_key``-indexed headline record per leg, under
+``run_registry`` in the details artifact — so A/Bs become
+``_cli compare`` invocations instead of transcript archaeology.  Fresh
+runs additionally emit ``trend``: every measured ``tpu_*_states_per_sec``
+against the BENCH_VALIDATED.json history with its ratio (``regressed``
+is the below-tolerance subset), and a validated full run embeds its
+``tpu_paxos3_report`` into BENCH_VALIDATED.json for ``regress.py
+--diff``.
+
 ``value``/``vs_baseline`` are recomputed on every emit from whatever
 numbers exist so far.
 
@@ -124,6 +136,15 @@ def _load_validated() -> dict:
 
 VALIDATED = _load_validated()
 
+# run ledger (docs/telemetry.md "Comparing runs"): bench registers each
+# leg EXPLICITLY (leg-tagged, with the already-built report body where
+# one exists), so main() CONSUMES the env knob into this global — left
+# in the environment it would also trigger every checker's join-time
+# auto-record and double-archive each leg (plus warm-ups and the CPU
+# baseline) as untagged noise.  run_tpu_attempt re-injects it into the
+# child's env; the child's main() consumes it again the same way.
+RUN_LEDGER_DIR = None
+
 
 def remaining() -> float:
     return DEADLINE_S - (time.monotonic() - T0)
@@ -189,13 +210,15 @@ except ImportError:  # pragma: no cover - bench copied out of the repo
     REGRESS_TOLERANCE = 0.85
 
 
-def _perf_regressions() -> list:
-    """Per-config ``{config, run, baseline, ratio}`` entries for every
-    freshly measured ``tpu_*_states_per_sec`` below ``REGRESS_TOLERANCE``
-    × its stored validated rate.  Compares only keys present in BOTH —
-    a carried/stale number never enters (the caller additionally gates
-    on the run being fresh), and configs the baseline never validated
-    cannot regress."""
+def _trend_deltas() -> list:
+    """Per-config ``{config, run, baseline, ratio}`` entries for EVERY
+    freshly measured ``tpu_*_states_per_sec`` with a stored validated
+    history value — the full trend view against BENCH_VALIDATED.json
+    (improvements and regressions alike; ``regressed`` is the
+    below-tolerance subset).  Compares only keys present in BOTH — a
+    carried/stale number never enters (the caller additionally gates on
+    the run being fresh), and configs the baseline never validated have
+    no trend."""
     out = []
     for key, base in sorted(VALIDATED.items()):
         if not key.endswith("_states_per_sec") or not key.startswith("tpu_"):
@@ -207,14 +230,22 @@ def _perf_regressions() -> list:
             or not base
         ):
             continue
-        if cur < REGRESS_TOLERANCE * base:
-            out.append({
-                "config": key,
-                "run": cur,
-                "baseline": base,
-                "ratio": round(cur / base, 3),
-            })
+        out.append({
+            "config": key,
+            "run": cur,
+            "baseline": base,
+            "ratio": round(cur / base, 3),
+        })
     return out
+
+
+def _perf_regressions(trend=None) -> list:
+    """The below-``REGRESS_TOLERANCE`` subset of :func:`_trend_deltas`
+    (ADVICE item 8's guard)."""
+    return [
+        e for e in (_trend_deltas() if trend is None else trend)
+        if e["run"] < REGRESS_TOLERANCE * e["baseline"]
+    ]
 
 
 def _compute_headline() -> dict:
@@ -245,9 +276,11 @@ def _compute_headline() -> dict:
             out["insert_path"] = "xla-scatter"
     if tpu_sps is not None:
         out["value"], out["fresh"] = tpu_sps, True
-        # perf-regression guard (ADVICE 8): only FRESH measurements are
-        # compared — a stale/carried artifact has nothing to regress
-        out["regressed"] = _perf_regressions()
+        # trend deltas vs the BENCH_VALIDATED history (details artifact)
+        # + the perf-regression guard (ADVICE 8): only FRESH measurements
+        # are compared — a stale/carried artifact has nothing to regress
+        out["trend"] = _trend_deltas()
+        out["regressed"] = _perf_regressions(out["trend"])
     elif VALIDATED.get("tpu_paxos3_states_per_sec") is not None:
         # validated fallback: the stored number is evidence, not a result.
         # It rides ONLY the explicit STALE annotation — value stays 0.0 so
@@ -376,6 +409,12 @@ def record_validated() -> None:
     # number travels with its per-stage cost ledger + bound verdicts
     if EXTRAS.get("tpu_paxos3_roofline"):
         doc["tpu_paxos3_roofline"] = EXTRAS["tpu_paxos3_roofline"]
+    # ...and the full embedded run report (regress.py --diff): future
+    # rounds diff their fresh report against this one with the
+    # contract-aware engine (telemetry/diff.py) — pre-registry
+    # baselines simply lack the key and never trip the gate
+    if EXTRAS.get("tpu_paxos3_report"):
+        doc["tpu_paxos3_report"] = EXTRAS["tpu_paxos3_report"]
     if EXTRAS.get("tpu_phases"):
         doc["tpu_phases"] = EXTRAS["tpu_phases"]
     pallas = EXTRAS.get("tpu_paxos3_pallas_states_per_sec")
@@ -629,6 +668,31 @@ def tpu_phase() -> dict:
 
     threading.Thread(target=heartbeat, daemon=True).start()
 
+    def _register(checker, leg: str, body=None) -> None:
+        """Archive one completed leg into the persistent run registry
+        (telemetry/registry.py) when STATERIGHT_TPU_RUN_DIR was set —
+        EVERY leg bench runs gets an archived report + index record, so
+        the on-chip A/B backlog reads as registry history instead of
+        transcript archaeology.  ``body`` reuses a report the leg
+        already built (the paxos-3/2pc-7 embeds) instead of
+        reconstructing discovery paths a second time.  Never voids a
+        measurement."""
+        if not RUN_LEDGER_DIR:
+            return
+        try:
+            from stateright_tpu.telemetry.registry import RunRegistry
+
+            rec = RunRegistry(RUN_LEDGER_DIR).record(
+                checker, leg=leg, body=body
+            )
+            out.setdefault("run_registry", {})[leg] = rec["run_id"]
+        except Exception as e:  # noqa: BLE001 - the ledger must never
+            # void the leg's number
+            sys.stderr.write(
+                f"bench: run-registry record failed for {leg}: "
+                f"{type(e).__name__}: {e}\n"
+            )
+
     phases: dict = {}  # per-phase wall breakdown (docs/perf.md)
     out["tpu_phases"] = phases
     _mark("backend-init (jax.devices)")
@@ -650,6 +714,7 @@ def tpu_phase() -> dict:
             f"tpu paxos2 unique {tpu_p2.unique_state_count()} != {PAXOS2_UNIQUE}"
         )
     out["tpu_paxos2_discoveries"] = sorted(tpu_p2.discoveries())
+    _register(tpu_p2, "paxos2_parity")
     _persist(out)
 
     # PRIMARY METRIC NEXT: paxos check 3 — everything else is secondary and
@@ -749,6 +814,7 @@ def tpu_phase() -> dict:
             "FULL enumeration: the complete paxos-3 space, pinned by "
             "tests/test_paxos_tensor.py (slow tier) at 1,194,428 unique"
         )
+    _register(tpu_p3, "paxos3", body=out.get("tpu_paxos3_report"))
     _persist(out)
 
     # flag-gated POR leg (BENCH_POR=1; docs/analysis.md "State-space
@@ -777,6 +843,7 @@ def tpu_phase() -> dict:
                 out["tpu_paxos3_por_note"] = (
                     "MISMATCH vs the full-expansion run — investigate"
                 )
+            _register(tpu_por, "paxos3_por")
             _mark("paxos3 por leg done")
         except Exception as e:  # noqa: BLE001 - the flag-gated leg must
             # never void the primary metric
@@ -823,6 +890,8 @@ def tpu_phase() -> dict:
                 por_u / full_u, 4
             ) if full_u else None
             out["tpu_paxos2_por_channel"] = tpu_pc.por_status()
+            _register(tpu_pcf, "paxos2_per_channel_full")
+            _register(tpu_pc, "paxos2_per_channel_por")
             _mark("paxos2 per-channel por leg done")
         except Exception as e:  # noqa: BLE001 - same never-void rule
             out["tpu_paxos2_por_channel_error"] = f"{type(e).__name__}: {e}"
@@ -840,6 +909,7 @@ def tpu_phase() -> dict:
             f"tpu 2pc5 unique {tpu_t5.unique_state_count()} != {TPC5_UNIQUE}"
         )
     out["tpu_2pc5_discoveries"] = sorted(tpu_t5.discoveries())
+    _register(tpu_t5, "2pc5_parity")
     try:
         t4 = TwoPhaseSys(4)
         kw4 = dict(sync=True, capacity=1 << 15)
@@ -858,6 +928,7 @@ def tpu_phase() -> dict:
         out["tpu_2pc4_note"] = (
             "full space; dominated by fixed per-run overhead at this size"
         )
+        _register(tpu_t4, "2pc4")
         _mark("2pc4 done")
     except Exception as e:  # noqa: BLE001
         out["tpu_2pc4_error"] = f"{type(e).__name__}: {e}"
@@ -884,6 +955,7 @@ def tpu_phase() -> dict:
             tpu_p3p.state_count() / dtp, 1
         )
         out["tpu_paxos3_pallas_sec"] = round(dtp, 3)
+        _register(tpu_p3p, "paxos3_pallas")
         _mark("paxos3 pallas A/B done")
     except Exception as e:  # noqa: BLE001
         out["tpu_paxos3_pallas_error"] = f"{type(e).__name__}: {e}"
@@ -939,6 +1011,7 @@ def tpu_phase() -> dict:
         out["tpu_2pc7_states"] = tpu_t7.state_count()
         out["tpu_2pc7_unique"] = tpu_t7.unique_state_count()
         out["tpu_2pc7_sec"] = round(dt7, 3)
+        _register(tpu_t7, "2pc7", body=out.get("tpu_2pc7_report"))
         _mark("2pc7 done")
     except Exception as e:  # noqa: BLE001
         out["tpu_2pc7_error"] = f"{type(e).__name__}: {e}"
@@ -1012,6 +1085,7 @@ def tpu_phase() -> dict:
                 out["tpu_2pc7_spill_note"] = (
                     "MISMATCH vs the unconstrained run — investigate"
                 )
+            _register(tpu_sp, "2pc7_spill")
             _mark("2pc7 spill leg done")
         except Exception as e:  # noqa: BLE001 - the flag-gated leg must
             # never void the primary metric
@@ -1051,6 +1125,7 @@ def tpu_phase() -> dict:
                     "overhead-dominated small space; spawn_auto() selects "
                     "the CPU engine for this config"
                 )
+            _register(c, tag)
             _mark(f"{tag} done")
         except Exception as e:  # noqa: BLE001
             out[f"tpu_{tag}_error"] = f"{type(e).__name__}: {e}"
@@ -1206,6 +1281,10 @@ def run_tpu_attempt(timeout_s: float, init_s: float = None) -> dict:
         BENCH_STAGE_FILE=stage_path,
         BENCH_TPU_TIMEOUT=str(int(timeout_s)),
     )
+    # re-inject the run-ledger root the parent's main() consumed: the
+    # child registers legs explicitly (its own main() consumes it again)
+    if RUN_LEDGER_DIR:
+        env["STATERIGHT_TPU_RUN_DIR"] = RUN_LEDGER_DIR
     try:
         return _run_tpu_child(timeout_s, init_s, stage_path, env)
     finally:
@@ -1441,6 +1520,12 @@ def ab_table(run_one=None) -> int:
 
 
 def main() -> int:
+    # consume the run-ledger knob FIRST (parent, child, probe, ab-table
+    # alike): legs register explicitly via _register; an env knob left
+    # in place would double-archive every leg through the checkers'
+    # join-time auto-record (plus warm-ups/CPU runs as untagged noise)
+    global RUN_LEDGER_DIR
+    RUN_LEDGER_DIR = os.environ.pop("STATERIGHT_TPU_RUN_DIR", None)
     if "--ab-table" in sys.argv:
         return ab_table()
     if "--tpu-probe" in sys.argv:

@@ -8,7 +8,7 @@ staleness and regressions LOUD:
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
-                      [--memory] [--spill] [--roofline]
+                      [--memory] [--spill] [--roofline] [--diff]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -477,6 +477,53 @@ def roofline_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def diff_verdict(run: dict, baseline: dict) -> dict:
+    """``--diff``: the contract-aware report diff
+    (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
+
+    Engages only when BOTH the fresh run and the stored baseline carry an
+    embedded ``tpu_paxos3_report`` — stale artifacts and pre-registry
+    baselines never trip (the ``--stages`` rule).  When both exist, the
+    pair must not classify DIVERGENT: a fresh round whose counts drift
+    from the validated history under a count-identical contract is
+    exactly the regression this gate exists to catch.  Incomparable
+    pairs (e.g. a prefix run against the stored full enumeration —
+    different instance target) are disclosed and skipped: nothing to
+    gate."""
+    rep = run.get("tpu_paxos3_report")
+    base = baseline.get("tpu_paxos3_report")
+    out: dict = {"present": bool(rep), "baseline_present": bool(base)}
+    if not rep or not base:
+        out["ok"] = True
+        out["skipped"] = (
+            "run and/or baseline carries no embedded tpu_paxos3_report "
+            "(pre-registry artifacts never trip)"
+        )
+        return out
+    try:
+        from stateright_tpu.telemetry.diff import diff_reports
+
+        d = diff_reports(base, rep)
+    except Exception as e:  # noqa: BLE001 - a diff crash is a gate
+        # failure, not a gate skip
+        out["ok"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    out["verdict"] = d["verdict"]
+    out["contract"] = d["contract"]
+    if d["violations"]:
+        out["violations"] = d["violations"]
+    if d["contract"] == "incomparable":
+        out["ok"] = True
+        out["skipped"] = (
+            "configs incomparable (different model/instance — e.g. a "
+            "prefix run vs the stored full enumeration); nothing to gate"
+        )
+        return out
+    out["ok"] = d["verdict"] != "DIVERGENT"
+    return out
+
+
 def stage_verdict(run: dict, baseline: dict) -> dict:
     """``--stages``: the per-stage attribution section (docs/perf.md).
 
@@ -511,7 +558,7 @@ def main(argv=None, fleet=None) -> int:
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
-    roofline = False
+    roofline = diff = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -534,6 +581,8 @@ def main(argv=None, fleet=None) -> int:
             spill = True
         elif a == "--roofline":
             roofline = True
+        elif a == "--diff":
+            diff = True
         else:
             pos.append(a)
     if pos:
@@ -595,6 +644,12 @@ def main(argv=None, fleet=None) -> int:
         # stale artifacts and pre-roofline baselines never trip
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["roofline"]["ok"]
+    if diff:
+        verdict["diff"] = diff_verdict(run, baseline)
+        # same freshness rule: stale artifacts and pre-registry
+        # baselines (no embedded report) never trip
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["diff"]["ok"]
     print(json.dumps(verdict))
     if not verdict["fresh"] and not allow_stale:
         sys.stderr.write(
@@ -679,6 +734,19 @@ def main(argv=None, fleet=None) -> int:
             "non-XLA-reconciling) roofline block (tpu_paxos3_roofline) — "
             "a perf number without its cost ledger cannot drive the MXU "
             "round (docs/roofline.md)\n"
+        )
+        return 1
+    if (
+        "diff" in verdict
+        and verdict["fresh"]
+        and not verdict["diff"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the fresh run's report DIVERGES from the validated "
+            "baseline's under the contract-aware diff (see stdout JSON) — "
+            "counts drifting across rounds under a count-identical "
+            "contract is a correctness regression, not noise "
+            "(docs/telemetry.md \"Comparing runs\")\n"
         )
         return 1
     return 0

@@ -36,6 +36,9 @@ class CheckerBuilder:
         self._audit_skip = False
         self.telemetry_opts: Optional[dict] = None
         self.report_path: Optional[str] = None
+        # persistent run registry (telemetry/registry.py); None = env
+        # default (STATERIGHT_TPU_RUN_DIR, off when unset)
+        self.run_dir: Optional[str] = None
         self.checked_mode = False
         # wavefront-throughput knobs (docs/perf.md); None = env default
         self.prewarm_mode: Optional[bool] = None
@@ -240,7 +243,9 @@ class CheckerBuilder:
         status.  The JSON
         body is deterministic for a fixed model/config — wall-clock-
         dependent values live in the markdown rendering only, and the
-        single volatile JSON field is the ``generated_at`` header."""
+        volatile fields are exactly the identity header named by
+        ``telemetry.report.VOLATILE_KEYS`` (``generated_at``,
+        ``run_id``, and ``parent_run_id`` on snapshot-resumed runs)."""
         import os as _os
 
         if _os.path.splitext(str(path))[1] == ".md":
@@ -250,6 +255,23 @@ class CheckerBuilder:
             )
         self.report_path = str(path)
         return self.cartography().memory_ledger()
+
+    def runs(self, path: str) -> "CheckerBuilder":
+        """Archive this run into the persistent run registry rooted at
+        ``path`` (``telemetry/registry.py``; docs/telemetry.md "Comparing
+        runs"): at the first ``join()`` after completion the
+        deterministic report body lands under ``<path>/runs/<run_id>.json``
+        and one index record — canonical ``config_key`` + headline
+        metrics — appends to ``<path>/index.jsonl``.  Composable with
+        ``report()`` (the archived body is the same document).
+
+        Contract (the memory ledger's strongest form, pinned by test):
+        the registry is pure host-side post-run I/O — on or off, the
+        step jaxpr is bit-identical and the engine cache unkeyed, both
+        engines.  Env equivalent: ``STATERIGHT_TPU_RUN_DIR=DIR``
+        (archives every run in the process)."""
+        self.run_dir = str(path)
+        return self
 
     def prewarm(self, enabled: bool = True) -> "CheckerBuilder":
         """Growth-stall elision for the single-device wavefront engine
@@ -599,20 +621,75 @@ class Checker:
     # runs simply carry no cartography block)
     _report_path: Optional[str] = None
     _report_written = False
+    # persistent run registry (telemetry/registry.py): the builder's
+    # .runs(DIR) (or STATERIGHT_TPU_RUN_DIR), honored like the report
+    _run_dir: Optional[str] = None
+    _run_recorded = False
+    _report_reentry = False
+    # run identity (docs/telemetry.md "Comparing runs"): minted lazily,
+    # stamped into the report header, snapshot manifests, and the
+    # registry index; parent_run_id set by snapshot resume
+    _run_id: Optional[str] = None
+    parent_run_id: Optional[str] = None
+
+    @property
+    def run_id(self) -> str:
+        """Stable unique id of this run (16 hex chars)."""
+        if self._run_id is None:
+            import uuid
+
+            self._run_id = uuid.uuid4().hex[:16]
+        return self._run_id
 
     def _maybe_write_report(self) -> None:
-        """Write the builder-requested run report exactly once, at the
-        first join() after completion (never from inside a run thread:
-        the report reconstructs discovery paths, which joins)."""
-        if (
-            self._report_path
-            and not self._report_written
-            and self.is_done()
-        ):
+        """Write the builder-requested run report (and archive into the
+        run registry when one is configured) exactly once, at the first
+        join() after completion (never from inside a run thread: the
+        report reconstructs discovery paths, which joins)."""
+        if not self.is_done():
+            return
+        body = None
+        if self._report_path and not self._report_written:
             self._report_written = True  # before write: never retry a crash
             from ..telemetry.report import write_report
 
-            write_report(self, self._report_path)
+            # building the report reconstructs discovery paths, which
+            # JOINS and re-enters this method — hold the registry off
+            # until the body exists, so the archive reuses it instead of
+            # building a second one from the nested call
+            self._report_reentry = True
+            try:
+                body = write_report(self, self._report_path)
+            finally:
+                self._report_reentry = False
+        self._maybe_record_run(body)
+
+    def _maybe_record_run(self, body=None) -> None:
+        """Archive the completed run into the persistent registry when
+        one is configured (builder ``.runs(DIR)`` or
+        ``STATERIGHT_TPU_RUN_DIR``) — pure post-run host I/O, exactly
+        once, never fatal to the join.  ``body`` reuses the report body
+        ``write_report`` just built (building one reconstructs discovery
+        paths; it must not run twice per join)."""
+        if self._run_recorded or self._report_reentry:
+            return
+        from ..telemetry.registry import resolve_run_dir
+
+        root = resolve_run_dir(self._run_dir)
+        if not root:
+            return
+        self._run_recorded = True  # before write: never retry a crash
+        try:
+            from ..telemetry.registry import RunRegistry
+
+            RunRegistry(root).record(self, body=body)
+        except Exception as e:  # noqa: BLE001 - the ledger must never
+            # break a join
+            print(
+                f"stateright-tpu: run-registry write failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
 
     # -- strategy-provided ---------------------------------------------------
 

@@ -97,6 +97,12 @@ class MpBfsChecker(ParentPointerTrace, Checker):
         # and the parent replays the history as "step" records post-merge
         self.flight_recorder = options._make_recorder("mp")
         self._report_path = options.report_path
+        self._run_dir = getattr(options, "run_dir", None)
+        # run-identity plumbing (telemetry/report.build_config): the
+        # prefix target is part of the instance identity and the device
+        # engines expose it as _target — mirror it here so a host run's
+        # archived config stays comparable with its device counterpart
+        self._target = options.target_state_count
         # an EXPLICIT processes count wins verbatim (processes=1 is a valid
         # single-worker debugging run); only the unset case falls through to
         # threads(N) and then to all cores

@@ -46,6 +46,7 @@ class WorkerPoolChecker(Checker):
         # processed job block, from whichever worker thread ran it
         self.flight_recorder = options._make_recorder(self._telemetry_tag)
         self._report_path = options.report_path
+        self._run_dir = getattr(options, "run_dir", None)
         self._count_lock = threading.Lock()
         self._state_count_shared = 0
         self._stop = threading.Event()

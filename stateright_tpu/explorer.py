@@ -20,6 +20,17 @@ HTTP surface mirrors the reference server (``src/checker/explorer.rs``):
    The UI draws throughput/occupancy sparklines and the cartography
    panel (depth/action histograms, property tallies, shard loads) from
    it.
+ - ``GET /.runs`` — the persistent run registry's index + per-config
+   trends (``telemetry/registry.py``; serve with ``runs_dir=`` or
+   ``STATERIGHT_TPU_RUN_DIR``).  ``GET /.runs/{run_id}`` returns one
+   archived report document; ``GET /.runs/diff/{a}/{b}`` the
+   contract-aware diff of two archived runs (``telemetry/diff.py``).
+   Every error on these endpoints uses the SAME stable shape as the
+   telemetry-off body — ``{"error": <token>, "hint": <prose>}`` — never
+   an ad-hoc string (pinned by the schema test): ``registry_disabled``
+   when no registry is configured, ``unknown_run`` for an unindexed id.
+   The UI's multi-run dashboard (run list, two-run diff panel,
+   per-config trend sparklines) reads these.
  - ``GET /`` — the bundled single-page UI (``ui/``; ours, not the
    reference's).
 
@@ -43,6 +54,15 @@ from .core import Expectation
 
 _UI_DIR = FsPath(__file__).parent / "ui"
 _SNAPSHOT_INTERVAL = 4.0  # seconds between recent-path refreshes
+
+
+def _error_body(error: str, hint: str) -> dict:
+    """The ONE stable machine-readable error shape every JSON endpoint
+    returns: tooling keys on ``error``, humans read ``hint``.  The
+    ``/.metrics`` telemetry-off body set the precedent; the ``/.runs``
+    family reuses it verbatim (no ad-hoc strings — pinned by the schema
+    test in tests/test_run_ledger.py)."""
+    return {"error": error, "hint": hint}
 
 
 class _Snapshot(CheckerVisitor):
@@ -231,6 +251,45 @@ def _metrics_view(checker) -> Optional[dict]:
     }
 
 
+def _runs_view(registry) -> dict:
+    """``GET /.runs``: the registry index + per-config trend series
+    (``telemetry/registry.py``) — the multi-run dashboard's data."""
+    from .telemetry.registry import REGISTRY_V
+
+    records = registry.index()  # one ledger parse serves both views
+    return {
+        "v": REGISTRY_V,
+        "root": registry.root,
+        "runs": records,
+        "trends": registry.trends(records),
+    }
+
+
+def _runs_diff_view(registry, a_id: str, b_id: str):
+    """``GET /.runs/diff/{a}/{b}``: the contract-aware diff of two
+    archived runs (``telemetry/diff.py``), with the index headlines
+    attached so throughput deltas render too.  Returns ``(code, body)``."""
+    from .telemetry.diff import diff_reports
+
+    docs = {}
+    for rid in (a_id, b_id):
+        doc = registry.find(rid)
+        if doc is None:
+            return 404, _error_body(
+                "unknown_run",
+                f"run {rid!r} is not archived in this registry "
+                "(GET /.runs lists the known ids)",
+            )
+        docs[rid] = doc
+    records = registry.index()  # one ledger parse for both headlines
+    return 200, diff_reports(
+        docs[a_id],
+        docs[b_id],
+        a_headline=registry.headline(a_id, records),
+        b_headline=registry.headline(b_id, records),
+    )
+
+
 def _pretty(state) -> str:
     return _indent_repr(repr(state))
 
@@ -302,7 +361,7 @@ def _state_views(model, fingerprints: list[int]) -> Optional[list[dict]]:
     return views
 
 
-def _make_handler(model, checker, snapshot: _Snapshot):
+def _make_handler(model, checker, snapshot: _Snapshot, registry=None):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet by default
             pass
@@ -325,22 +384,69 @@ def _make_handler(model, checker, snapshot: _Snapshot):
             if path == "/.metrics":
                 view = _metrics_view(checker)
                 if view is None:
-                    # STABLE machine-readable body (tooling keys on
-                    # ``error``, humans read ``hint``): telemetry off is an
-                    # expected state, not a routing failure — downstream
-                    # pollers must be able to distinguish it from a typo'd
-                    # URL without parsing prose
+                    # STABLE machine-readable body (_error_body):
+                    # telemetry off is an expected state, not a routing
+                    # failure — downstream pollers must be able to
+                    # distinguish it from a typo'd URL without parsing
+                    # prose
                     self._send_json(
-                        {
-                            "error": "telemetry_disabled",
-                            "hint": "spawn the run with .telemetry() "
+                        _error_body(
+                            "telemetry_disabled",
+                            "spawn the run with .telemetry() "
                             "(add cartography=True for the search "
                             "counters) to enable /.metrics",
-                        },
+                        ),
                         404,
                     )
                     return
                 self._send_json(view)
+                return
+            if path == "/.runs" or path.startswith("/.runs/"):
+                if registry is None:
+                    # same stable shape as telemetry_disabled: a server
+                    # without a registry is an expected state
+                    self._send_json(
+                        _error_body(
+                            "registry_disabled",
+                            "serve with runs_dir=DIR (or set "
+                            "STATERIGHT_TPU_RUN_DIR) to enable the "
+                            "multi-run endpoints",
+                        ),
+                        404,
+                    )
+                    return
+                rest = path[len("/.runs"):].strip("/")
+                if not rest:
+                    self._send_json(_runs_view(registry))
+                    return
+                parts = rest.split("/")
+                if parts[0] == "diff":
+                    if len(parts) != 3:
+                        self._send_json(
+                            _error_body(
+                                "bad_diff_request",
+                                "use /.runs/diff/{run_id_a}/{run_id_b}",
+                            ),
+                            404,
+                        )
+                        return
+                    code, body = _runs_diff_view(
+                        registry, parts[1], parts[2]
+                    )
+                    self._send_json(body, code)
+                    return
+                doc = registry.find(parts[0])
+                if doc is None:
+                    self._send_json(
+                        _error_body(
+                            "unknown_run",
+                            f"run {parts[0]!r} is not archived in this "
+                            "registry (GET /.runs lists the known ids)",
+                        ),
+                        404,
+                    )
+                    return
+                self._send_json(doc)
                 return
             if path == "/.states" or path.startswith("/.states/"):
                 raw = path[len("/.states") :].strip("/")
@@ -402,10 +508,21 @@ class ExplorerServer:
         builder,
         addr: str = "localhost:3000",
         strategy: str = "bfs",
+        runs_dir: Optional[str] = None,
         **spawn_kw,
     ):
         host, _, port = addr.partition(":")
         self.snapshot = _Snapshot()
+        # persistent run registry (telemetry/registry.py): the multi-run
+        # dashboard's data source — explicit runs_dir wins, else the
+        # builder's .runs(DIR), else STATERIGHT_TPU_RUN_DIR; absent =
+        # the /.runs endpoints answer registry_disabled
+        from .telemetry.registry import RunRegistry, resolve_run_dir
+
+        root = resolve_run_dir(
+            runs_dir or getattr(builder, "run_dir", None)
+        )
+        self.registry = RunRegistry(root) if root else None
         if strategy == "tpu":
             # no per-state visitor on device (states never materialize);
             # recent_path stays empty, the counters are live
@@ -420,7 +537,9 @@ class ExplorerServer:
         else:
             raise ValueError(f"unknown Explorer strategy {strategy!r}")
         self.model = builder.model
-        handler = _make_handler(self.model, self.checker, self.snapshot)
+        handler = _make_handler(
+            self.model, self.checker, self.snapshot, registry=self.registry
+        )
         self.httpd = ThreadingHTTPServer((host, int(port or "3000")), handler)
         self.addr = f"{self.httpd.server_address[0]}:{self.httpd.server_address[1]}"
 
@@ -443,13 +562,19 @@ def serve(
     addr: str = "localhost:3000",
     block: bool = True,
     strategy: str = "bfs",
+    runs_dir: Optional[str] = None,
     **spawn_kw,
 ):
     """Spawn a check over ``builder`` and serve the Explorer UI
     (reference ``checker.rs:108-114``).  ``strategy="tpu"`` serves a device
     wavefront run instead of host BFS; with it, extra keyword arguments pass
-    through to ``spawn_tpu`` (e.g. ``batch=...``)."""
-    server = ExplorerServer(builder, addr, strategy=strategy, **spawn_kw)
+    through to ``spawn_tpu`` (e.g. ``batch=...``).  ``runs_dir`` (or
+    ``STATERIGHT_TPU_RUN_DIR`` / a builder ``.runs(DIR)``) arms the
+    multi-run dashboard: ``/.runs`` endpoints + run list / two-run diff /
+    trend panels over the persistent run registry."""
+    server = ExplorerServer(
+        builder, addr, strategy=strategy, runs_dir=runs_dir, **spawn_kw
+    )
     if block:
         server.serve_forever()
         return server

@@ -44,6 +44,7 @@ def run_cli(
     independence: Optional[Callable[[list], None]] = None,
     capacity: Optional[Callable[[list], None]] = None,
     costmodel: Optional[Callable[[list], None]] = None,
+    compare: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -77,6 +78,8 @@ def run_cli(
         capacity(rest)
     elif cmd == "costmodel" and costmodel is not None:
         costmodel(rest)
+    elif cmd == "compare" and compare is not None:
+        compare(rest)
     else:
         print("USAGE:")
         print(usage)
@@ -108,6 +111,11 @@ def run_cli(
             print("  <example> costmodel [--out=F] [ARGS]  # roofline "
                   "cost ledger: per-stage FLOPs/bytes, XLA "
                   "reconciliation, MXU candidates (docs/roofline.md)")
+        if compare is not None:
+            print("  <example> compare A B [--registry=DIR] "
+                  "[--expect=VERDICT]  # contract-aware run diff: "
+                  "report files or registry run ids "
+                  "(docs/telemetry.md \"Comparing runs\")")
 
 
 def pop_checked(rest: list) -> tuple:
@@ -890,6 +898,151 @@ def fleet_costmodel(args: Optional[list] = None, stream=None) -> int:
     return 0 if ok else 1
 
 
+# -- compare / runs verbs (telemetry/registry.py + telemetry/diff.py) --------
+
+
+def _load_report_arg(arg: str, registry_dir: Optional[str]) -> tuple:
+    """``(doc, headline)`` for one compare argument: a report JSON file
+    path, or a run id resolved against the registry (``--registry=DIR``
+    or ``STATERIGHT_TPU_RUN_DIR``).  Headline (wall-clock metrics) only
+    exists for registry-resolved runs."""
+    import json
+
+    from ..telemetry.registry import RunRegistry, resolve_run_dir
+
+    if os.path.isfile(arg):
+        with open(arg) as f:
+            return json.load(f), None
+    root = resolve_run_dir(registry_dir)
+    if root is None:
+        raise SystemExit(
+            f"compare: {arg!r} is neither a report file nor a resolvable "
+            "run id (pass --registry=DIR or set STATERIGHT_TPU_RUN_DIR)"
+        )
+    reg = RunRegistry(root)
+    doc = reg.find(arg)
+    if doc is None:
+        raise SystemExit(f"compare: run {arg!r} not found in {root}")
+    return doc, reg.headline(arg)
+
+
+def compare_reports_cmd(rest: list, stream=None) -> int:
+    """The ``compare`` verb: contract-aware diff of two run reports
+    (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
+
+    Arguments are report JSON files or registry run ids.  Prints the
+    human rendering plus one machine-readable JSON line (the diff
+    document).  Exit 0 unless the pair classifies DIVERGENT (a promised
+    contract is broken) or ``--expect=VERDICT`` names a different
+    class."""
+    import json
+
+    from ..telemetry.diff import DIVERGENT, diff_reports, render_diff
+
+    stream = stream or sys.stdout
+    registry, expect, args = None, None, []
+    for a in rest:
+        if a.startswith("--registry="):
+            registry = a[len("--registry="):]
+        elif a.startswith("--expect="):
+            expect = a[len("--expect="):].upper().replace("_", "-")
+        else:
+            args.append(a)
+    if len(args) != 2:
+        print(
+            "usage: compare A B [--registry=DIR] [--expect=IDENTICAL|"
+            "ISOMORPHIC|PERF-ONLY|DIVERGENT]  (A/B: report JSON files "
+            "or registry run ids)",
+            file=stream,
+        )
+        return 2
+    a_doc, a_head = _load_report_arg(args[0], registry)
+    b_doc, b_head = _load_report_arg(args[1], registry)
+    d = diff_reports(a_doc, b_doc, a_headline=a_head, b_headline=b_head)
+    print(render_diff(d, label_a=args[0], label_b=args[1]), file=stream)
+    print(json.dumps(d), file=stream)
+    if expect:
+        # an explicit expectation is the whole judgement — including
+        # --expect=DIVERGENT asserting a known-bad pair stays caught
+        if d["verdict"] != expect:
+            print(
+                f"compare: verdict {d['verdict']} != expected {expect}",
+                file=stream,
+            )
+            return 1
+        return 0
+    return 1 if d["verdict"] == DIVERGENT else 0
+
+
+def make_compare_cmd() -> Callable:
+    """The ``compare`` CLI verb (model-independent: it reads report
+    artifacts, not models — every verb-bearing example mounts the same
+    one so the A/B workflow stays next to the verbs that produce the
+    reports)."""
+
+    def _compare(rest: list) -> None:
+        rc = compare_reports_cmd(rest)
+        if rc:
+            raise SystemExit(rc)
+
+    return _compare
+
+
+def fleet_runs(args: Optional[list] = None, stream=None) -> int:
+    """``runs [DIR]``: list the persistent run registry — one line per
+    archived run (id, config_key, model/engine, headline) plus the
+    per-config trend summary the Explorer's dashboard draws."""
+    from ..telemetry.registry import RunRegistry, resolve_run_dir
+
+    stream = stream or sys.stdout
+    args = list(args or [])
+    root = resolve_run_dir(args[0] if args else None)
+    if root is None:
+        print(
+            "runs: no registry configured (pass DIR or set "
+            "STATERIGHT_TPU_RUN_DIR)",
+            file=stream,
+        )
+        return 2
+    reg = RunRegistry(root)
+    recs = reg.index()
+    if not recs:
+        print(f"runs: registry at {root} is empty", file=stream)
+        return 0
+    for r in recs:
+        h = r.get("headline") or {}
+        bits = [
+            str(r.get("run_id")),
+            str(r.get("config_key") or "-"),
+            f"{r.get('model')}/{r.get('engine')}",
+            f"unique={h.get('unique')}",
+            f"done={h.get('done')}",
+        ]
+        if h.get("states_per_sec") is not None:
+            bits.append(f"{h['states_per_sec']}/s")
+        if r.get("leg"):
+            bits.append(f"leg={r['leg']}")
+        if r.get("parent_run_id"):
+            bits.append(f"parent={r['parent_run_id']}")
+        bits.append(str(r.get("generated_at") or ""))
+        print("  ".join(bits), file=stream)
+    trends = reg.trends(recs)
+    print(
+        f"runs: {len(recs)} archived over {len(trends)} config(s) at "
+        f"{root}",
+        file=stream,
+    )
+    for key, series in sorted(trends.items()):
+        if len(series) > 1:
+            u = [s.get("unique") for s in series]
+            print(
+                f"  trend {key}: {len(series)} runs, unique "
+                f"{u[0]} -> {u[-1]}",
+                file=stream,
+            )
+    return 0
+
+
 # -- profile verb ------------------------------------------------------------
 
 
@@ -1122,6 +1275,10 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_capacity(argv[1:]))
     if argv and argv[0] == "costmodel":
         raise SystemExit(fleet_costmodel(argv[1:]))
+    if argv and argv[0] == "runs":
+        raise SystemExit(fleet_runs(argv[1:]))
+    if argv and argv[0] == "compare":
+        raise SystemExit(compare_reports_cmd(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
@@ -1148,6 +1305,15 @@ def main(argv: Optional[list] = None) -> None:
     print("    roofline cost ledger over the fleet: per-stage "
           "FLOPs/bytes, XLA reconciliation, MXU candidates "
           "(docs/roofline.md); exit 1 on a non-reconciling ledger")
+    print("  python -m stateright_tpu.models._cli runs [DIR]")
+    print("    list the persistent run registry: archived runs, "
+          "config keys, per-config trends (docs/telemetry.md "
+          "\"Comparing runs\")")
+    print("  python -m stateright_tpu.models._cli compare A B "
+          "[--registry=DIR] [--expect=VERDICT]")
+    print("    contract-aware diff of two run reports (files or "
+          "registry run ids); exit 1 on DIVERGENT or an --expect "
+          "mismatch")
 
 
 if __name__ == "__main__":

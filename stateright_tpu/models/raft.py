@@ -41,6 +41,7 @@ from ._cli import (
     make_audit_cmd,
     make_profile_cmd,
     make_capacity_cmd,
+    make_compare_cmd,
     make_costmodel_cmd,
     make_report_cmd,
     make_independence_cmd,
@@ -338,6 +339,7 @@ def main(argv=None) -> None:
         report=make_report_cmd(_audit_models),
         capacity=make_capacity_cmd(_audit_models),
         costmodel=make_costmodel_cmd(_audit_models),
+        compare=make_compare_cmd(),
         argv=argv,
     )
 

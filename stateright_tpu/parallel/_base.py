@@ -198,6 +198,10 @@ class WavefrontChecker(Checker):
         # when the builder requested CheckerBuilder.report(PATH)
         self._report_path = getattr(options, "report_path", None)
         self._report_written = False
+        # persistent run registry (telemetry/registry.py): archived once
+        # at join() when configured (builder .runs(DIR) or the
+        # STATERIGHT_TPU_RUN_DIR env knob)
+        self._run_dir = getattr(options, "run_dir", None)
         tag = "wavefront" if self._engine_tag == "single" else self._engine_tag
         self.flight_recorder = options._make_recorder(tag)
         if self._spill and self.flight_recorder is not None:
@@ -429,6 +433,15 @@ class WavefrontChecker(Checker):
                 "resume snapshot was taken from a different model "
                 "(init fingerprints / tensor signature disagree)"
             )
+        # lineage capture: the manifest's run_id (absent on pre-registry
+        # snapshots) becomes this run's parent — the report header,
+        # registry index, and diff engine all read it
+        rid = snap.get("run_id")
+        if rid is not None and self.parent_run_id is None:
+            # npz round-trips strings as 0-d unicode arrays
+            self.parent_run_id = str(np.asarray(rid).item()) if hasattr(
+                rid, "dtype"
+            ) else str(rid)
         if not getattr(self, "_spill", False) and (
             int(snap.get("spill_base", 0) or 0) > 0
             or "spill_fp" in snap

@@ -923,6 +923,9 @@ class ShardedTpuChecker(WavefrontChecker):
         snap["cand_factor"] = cf
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
+        # run lineage: same manifest field as the wavefront engine, so
+        # the registry links kill+resume chains (telemetry/registry.py)
+        snap["run_id"] = self.run_id
         # snapshot manifest: analytic footprint at these capacities, for
         # the resume-time fits guard (parallel/_base._check_snapshot_sig)
         fb = self._analytic_footprint_bytes(

@@ -1757,6 +1757,10 @@ class TpuChecker(WavefrontChecker):
         snap["width"] = self.tensor.width
         snap["engine"] = self._engine_tag
         snap["model_sig"] = self._model_sig()
+        # run lineage (docs/telemetry.md "Comparing runs"): the manifest
+        # carries this run's id, so a resumed run records it as
+        # parent_run_id and the run registry links kill+resume chains
+        snap["run_id"] = self.run_id
         # snapshot manifest (telemetry/memory.py): the analytic byte
         # footprint at these capacities travels with the snapshot, so a
         # resume on a smaller device can warn BEFORE compiling

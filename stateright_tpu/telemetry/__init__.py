@@ -21,6 +21,14 @@ Pieces:
    / Perfetto.
  - :class:`ScopedProfiler` (``profile.py``) — a scoped ``jax.profiler``
    hook that traces the first N hot steps of a device run to a logdir.
+ - :class:`RunRegistry` (``registry.py``) — the persistent append-only
+   run ledger (``CheckerBuilder.runs(DIR)`` / ``STATERIGHT_TPU_RUN_DIR``):
+   archived run reports + a ``config_key``-indexed headline record per
+   run.
+ - ``diff.py`` — the contract-aware cross-run diff
+   (IDENTICAL / ISOMORPHIC / PERF-ONLY / DIVERGENT) behind the
+   ``compare`` CLI verb, ``regress.py --diff``, and the Explorer's
+   multi-run dashboard (docs/telemetry.md "Comparing runs").
 
 Enabled per run via ``model.checker().telemetry()``; the recorder then
 hangs off the checker as ``checker.flight_recorder``.  **Overhead
@@ -35,10 +43,12 @@ jaxpr and enabling it costs <3% wall time (asserted in
 from .recorder import FlightRecorder, STATUS_NAMES
 from .profile import ScopedProfiler
 from .health import HealthTracker
+from .registry import RunRegistry
 
 __all__ = [
     "FlightRecorder",
     "HealthTracker",
+    "RunRegistry",
     "ScopedProfiler",
     "STATUS_NAMES",
 ]

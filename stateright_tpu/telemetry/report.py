@@ -21,6 +21,17 @@ in the MARKDOWN rendering only, clearly sectioned as non-deterministic.
 Schema versioning: ``v`` (:data:`REPORT_V`) at the top level; the
 embedded cartography block carries its own ``v``
 (``ops.cartography.CARTOGRAPHY_V``).
+
+Run identity (docs/telemetry.md "Comparing runs"): the deterministic
+body carries a ``config`` block — the canonical run configuration
+(model, instance signature, engine, flag set, encoding, device spec,
+git rev) plus its 16-hex ``key`` (:func:`config_key`) — and the written
+document additionally carries a ``run_id`` (and, for runs resumed from
+a snapshot, the parent's ``parent_run_id``) in the volatile header next
+to ``generated_at``.  :data:`VOLATILE_KEYS` is the SCHEMA for what is
+volatile: the diff engine (``telemetry/diff.py``) scrubs exactly this
+tuple, so a new volatile header field is ignored there automatically
+instead of by hand-listing.
 """
 
 from __future__ import annotations
@@ -33,6 +44,11 @@ from .health import phase_timeline
 
 REPORT_V = 1
 
+# volatile identity/header fields stamped at write time — everything a
+# cross-run diff must ignore lives HERE (telemetry/diff.py consults this
+# tuple at diff time; never hand-list these downstream)
+VOLATILE_KEYS = ("generated_at", "run_id", "parent_run_id")
+
 # growth-record fields that are count-derived (the record's ``t``/``seq``
 # are wall-clock/ordering bookkeeping and stay out of the report body)
 _GROWTH_KEYS = ("status", "unique", "cap", "qcap", "cand", "fcap", "bucket")
@@ -41,6 +57,142 @@ _GROWTH_KEYS = ("status", "unique", "cap", "qcap", "cand", "fcap", "bucket")
 def _expectation_name(prop) -> str:
     # Expectation is a proper enum; its .name is ALWAYS/SOMETIMES/...
     return getattr(prop.expectation, "name", str(prop.expectation)).lower()
+
+
+def _git_rev() -> Optional[str]:
+    """Short git revision of the checkout this package runs from (walks
+    up from the package dir; plain file reads, no subprocess — the
+    report writer must never fork).  None outside a git checkout."""
+    import pathlib
+
+    try:
+        for p in pathlib.Path(__file__).resolve().parents:
+            head = p / ".git" / "HEAD"
+            if not head.is_file():
+                continue
+            ref = head.read_text().strip()
+            if not ref.startswith("ref:"):
+                return ref[:12]  # detached HEAD: the hash itself
+            name = ref.split(None, 1)[1]
+            ref_path = p / ".git" / name
+            if ref_path.is_file():
+                return ref_path.read_text().strip()[:12]
+            packed = p / ".git" / "packed-refs"
+            if packed.is_file():
+                for line in packed.read_text().splitlines():
+                    if line.endswith(" " + name):
+                        return line.split()[0][:12]
+            return None
+    except OSError:
+        return None
+    return None
+
+
+def config_key(config: dict) -> str:
+    """Canonical 16-hex key over a ``config`` block (minus the ``key``
+    field itself): sorted-key compact JSON, sha256-truncated.  Two runs
+    share a ``config_key`` iff they are the same measurement
+    configuration — the grouping key for registry trends."""
+    import hashlib
+
+    body = {k: v for k, v in config.items() if k != "key"}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def build_config(checker) -> dict:
+    """The report's deterministic ``config`` block: the canonical run
+    configuration the diff engine classifies flag deltas over
+    (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
+
+    ``instance.sig`` hashes the init-state fingerprints + tensor shape +
+    property count, so different instance arguments (paxos-2 vs paxos-3)
+    get different keys without per-model plumbing; ``flags`` records the
+    feature set the engines actually resolved (builder + env knobs);
+    ``device``/``git_rev`` pin where and at what revision the run
+    happened (perf-class aspects for the diff)."""
+    import hashlib
+
+    model = checker.model
+    tag = getattr(checker, "_engine_tag", None)
+    if tag == "single":
+        tag = "wavefront"
+    # instance identity must be ENGINE-INDEPENDENT (a wavefront-vs-BFS
+    # pair of the same instance is comparable): host checkers carry no
+    # .tensor, so fall back to the model's cached twin — init
+    # fingerprints alone can coincide across instance sizes (all-zero
+    # init rows; the _model_sig rationale), the tensor shape breaks the
+    # tie
+    tensor = getattr(checker, "tensor", None)
+    if tensor is None:
+        try:
+            from ..parallel.tensor_model import twin_or_none
+
+            tensor = twin_or_none(model)
+        except Exception:  # noqa: BLE001 - identity must never break
+            tensor = None
+    props = list(model.properties())
+    try:
+        fps = sorted(
+            int(model.fingerprint_state(s)) for s in model.init_states()
+        )
+    except Exception:  # noqa: BLE001 - identity must never break a report
+        fps = []
+    sig_src = fps + [
+        int(getattr(tensor, "width", 0) or 0),
+        int(getattr(tensor, "max_actions", 0) or 0),
+        len(props),
+    ] + sorted(p.name for p in props)
+    sig = hashlib.sha256(json.dumps(sig_src).encode()).hexdigest()[:16]
+    flags = {
+        "telemetry": getattr(checker, "flight_recorder", None) is not None,
+        "cartography": bool(getattr(checker, "_cartography", False)),
+        "memory": getattr(checker, "_mem_ledger", None) is not None,
+        "roofline": getattr(checker, "_roofline_ledger", None) is not None,
+        "checked": bool(getattr(checker, "_checked", False)),
+        "prededup": bool(getattr(checker, "_prededup", False)),
+        "spill": bool(getattr(checker, "_spill", False)),
+        # active reduction only: a por() run that FELL BACK ran full
+        # expansion and must diff as such (the fallback reason lives in
+        # the por block)
+        "por": bool(getattr(checker, "_por", False)),
+        "symmetry": getattr(checker, "_symmetry", None) is not None,
+        "prewarm": bool(getattr(checker, "_prewarm", False)),
+        "pallas": bool(getattr(checker, "_pallas", False)),
+        "compile_cache": bool(
+            getattr(checker, "_compile_cache_dir", None)
+        ),
+    }
+    try:
+        import jax
+
+        d0 = jax.devices()[0]
+        device = str(getattr(d0, "device_kind", None) or d0.platform)
+    except Exception:  # noqa: BLE001 - identity must never break a report
+        device = None
+    # the prefix target is instance identity (a 4000-state prefix is a
+    # different measurement than the full enumeration): device engines
+    # and mp store it as _target, the thread-pool checkers keep only the
+    # builder options
+    target = getattr(checker, "_target", None)
+    if target is None:
+        target = getattr(
+            getattr(checker, "_options", None), "target_state_count", None
+        )
+    cfg = {
+        "model": type(model).__name__,
+        "instance": {
+            "sig": sig,
+            "target": target,
+        },
+        "engine": tag or type(checker).__name__,
+        "encoding": getattr(tensor, "network_encoding", None),
+        "flags": flags,
+        "device": device,
+        "git_rev": _git_rev(),
+    }
+    cfg["key"] = config_key(cfg)
+    return cfg
 
 
 def build_report(checker) -> dict:
@@ -72,6 +224,10 @@ def build_report(checker) -> dict:
         "v": REPORT_V,
         "model": type(model).__name__,
         "engine": tag or type(checker).__name__,
+        # canonical run configuration + config_key (deterministic for a
+        # fixed model/config/machine/checkout): what the registry indexes
+        # and the diff engine classifies flag deltas over
+        "config": build_config(checker),
         "totals": totals,
         "properties": [
             {
@@ -481,6 +637,28 @@ def render_markdown(report: dict, rec=None, roofline_live=None) -> str:
     return "\n".join(lines)
 
 
+def identity_doc(checker, body: dict) -> dict:
+    """The written run-report document: the volatile identity header
+    (exactly :data:`VOLATILE_KEYS` — the stamp, the run id, and for
+    snapshot-resumed runs the parent's id, so the registry links
+    kill+resume chains) ahead of the deterministic ``body``.  The ONE
+    header assembly, shared by :func:`write_report` and the run
+    registry — a new volatile field lands here and in
+    :data:`VOLATILE_KEYS` together."""
+    import datetime
+
+    doc = {
+        "generated_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "run_id": getattr(checker, "run_id", None),
+    }
+    parent = getattr(checker, "parent_run_id", None)
+    if parent:
+        doc["parent_run_id"] = parent
+    doc.update(body)
+    return doc
+
+
 def write_report(checker, path: str) -> dict:
     """Render ``checker`` into ``path`` (JSON) + the sibling markdown.
 
@@ -497,13 +675,7 @@ def write_report(checker, path: str) -> dict:
             "markdown rendering lands next to it as <path-stem>.md"
         )
     body = build_report(checker)
-    import datetime
-
-    doc = {
-        "generated_at": datetime.datetime.now(datetime.timezone.utc)
-        .isoformat(timespec="seconds"),
-        **body,
-    }
+    doc = identity_doc(checker, body)
     with open(path, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")

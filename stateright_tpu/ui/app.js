@@ -320,6 +320,142 @@ async function pollMetrics() {
   }
 }
 
+// ----------------------------------------------------------------- runs --
+// Multi-run dashboard over the /.runs endpoints (telemetry/registry.py +
+// telemetry/diff.py): the registry's run list, per-config_key trend
+// sparklines, and a two-run contract-aware diff panel.  A server without
+// a registry answers 404 {"error": "registry_disabled", ...} once and
+// the panel stays hidden (the /.metrics probe discipline).
+let runsAvailable = null;
+let diffSelection = []; // up to two selected run_ids
+
+function renderRunsList(runs) {
+  const ul = $("runs-list");
+  ul.innerHTML = "";
+  for (const r of runs.slice(-30).reverse()) {
+    const li = document.createElement("li");
+    li.className = "run-row";
+    if (diffSelection.includes(r.run_id)) li.classList.add("selected");
+    const h = r.headline || {};
+    const id = document.createElement("span");
+    id.className = "run-id";
+    id.textContent = r.run_id.slice(0, 8);
+    id.title = r.run_id + "  config " + (r.config_key || "-");
+    const desc = document.createElement("span");
+    desc.textContent =
+      " " + r.model + "/" + r.engine +
+      (r.leg ? " [" + r.leg + "]" : "") +
+      "  unique=" + (h.unique === undefined ? "-" : h.unique) +
+      (h.states_per_sec ? "  " + fmtRate(h.states_per_sec) : "") +
+      (r.parent_run_id ? "  ⤴" + r.parent_run_id.slice(0, 6) : "");
+    li.append(id, desc);
+    li.addEventListener("click", () => selectRunForDiff(r.run_id));
+    ul.appendChild(li);
+  }
+}
+
+function renderRunTrends(trends) {
+  const div = $("runs-trends");
+  div.innerHTML = "";
+  for (const [key, series] of Object.entries(trends || {})) {
+    if (series.length < 2) continue;
+    const row = document.createElement("div");
+    row.className = "spark-row";
+    const label = document.createElement("div");
+    label.className = "spark-label";
+    const metric = series.some((s) => s.states_per_sec)
+      ? "states_per_sec" : "unique";
+    label.textContent =
+      "config " + key.slice(0, 8) + " · " + metric +
+      " over " + series.length + " runs";
+    const svg = document.createElementNS(
+      "http://www.w3.org/2000/svg", "svg"
+    );
+    svg.setAttribute("viewBox", "0 0 300 40");
+    svg.setAttribute("preserveAspectRatio", "none");
+    const last = sparkline(svg, series.map((s) => s[metric]),
+      metric === "states_per_sec" ? fmtRate : null);
+    if (last !== null && last !== undefined)
+      label.textContent += " · " + last;
+    row.append(label, svg);
+    div.appendChild(row);
+  }
+}
+
+async function selectRunForDiff(runId) {
+  if (diffSelection.includes(runId)) {
+    diffSelection = diffSelection.filter((r) => r !== runId);
+  } else {
+    diffSelection = diffSelection.concat([runId]).slice(-2);
+  }
+  await pollRuns();
+  if (diffSelection.length !== 2) {
+    $("runs-verdict").hidden = true;
+    $("runs-diff").textContent = "select two runs to diff";
+    return;
+  }
+  const [a, b] = diffSelection;
+  const r = await fetch("/.runs/diff/" + a + "/" + b);
+  const d = await r.json();
+  const v = $("runs-verdict");
+  if (!r.ok || d.error) {
+    // the server's stable error body ({error, hint}): surface the hint
+    // instead of rendering an undefined verdict
+    v.hidden = false;
+    v.textContent = d.error || "diff failed";
+    v.className = "diff-verdict flag-bad";
+    $("runs-diff").textContent = d.hint || "";
+    return;
+  }
+  v.hidden = false;
+  v.textContent = d.verdict + " (contract: " + d.contract + ")";
+  v.className = "diff-verdict " +
+    (d.verdict === "DIVERGENT" ? "flag-bad" : "flag-ok");
+  const lines = [];
+  const t = (d.blocks || {}).totals || {};
+  for (const k of ["states", "unique", "max_depth"]) {
+    const p = t[k] || {};
+    lines.push(
+      k + ": " + p.a + (p.match ? "" : " -> " + p.b +
+      (p.delta !== undefined ? " (" + (p.delta > 0 ? "+" : "") + p.delta + ")" : ""))
+    );
+  }
+  for (const p of (d.blocks || {}).properties || []) {
+    lines.push(
+      "property " + p.name + ": a=" + p.a + " b=" + p.b +
+      (p.match ? "" : "  MISMATCH")
+    );
+  }
+  const perf = (d.blocks || {}).perf;
+  if (perf && perf.states_per_sec)
+    lines.push(
+      "throughput: " + perf.states_per_sec.a + " -> " +
+      perf.states_per_sec.b + " states/s"
+    );
+  for (const viol of d.violations || []) {
+    lines.push("[" + viol.rule + "] " + viol.field + ": " + viol.detail);
+  }
+  $("runs-diff").textContent = lines.join("\n");
+}
+
+async function pollRuns() {
+  if (runsAvailable === false) return;
+  try {
+    const r = await fetch("/.runs");
+    if (!r.ok) {
+      runsAvailable = false;
+      return;
+    }
+    const view = await r.json();
+    runsAvailable = true;
+    $("runs").hidden = false;
+    renderRunsList(view.runs || []);
+    renderRunTrends(view.trends || {});
+  } catch (e) {
+    /* transient; retry next poll */
+  }
+}
+
 // ----------------------------------------------------------------- steps --
 let loadSeq = 0; // drop out-of-order responses so fast navigation stays sane
 
@@ -452,6 +588,8 @@ document.addEventListener("keydown", (e) => {
 window.addEventListener("hashchange", route);
 pollStatus();
 pollMetrics();
+pollRuns();
 setInterval(pollStatus, 2000);
 setInterval(pollMetrics, 2000);
+setInterval(pollRuns, 10000); // the registry is append-only; poll gently
 route();

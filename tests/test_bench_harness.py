@@ -422,3 +422,92 @@ def test_ab_table_failure_emits_one_line_rc1(bench_env, capsys):
     (line,) = [json.loads(l) for l in
                capsys.readouterr().out.strip().splitlines()]
     assert "tunnel down" in line["error"]
+
+
+def test_trend_deltas_cover_every_validated_config(bench_env, capsys):
+    """A fresh run's details carry ``trend``: EVERY measured
+    tpu_*_states_per_sec with a stored history value and its ratio —
+    improvements and regressions alike (``regressed`` stays the
+    below-tolerance subset); never-validated configs have no trend
+    entry, and stale runs carry no trend at all."""
+    b = _load_bench()
+    b.VALIDATED.update({
+        "tpu_paxos3_states_per_sec": 200_000.0,
+        "tpu_2pc7_states_per_sec": 100_000.0,
+        "validated_at": "2025-01-01T00:00:00Z",
+    })
+    b.emit(
+        tpu_paxos3_states_per_sec=100_000.0,  # 0.5x: regression + trend
+        tpu_2pc7_states_per_sec=150_000.0,  # 1.5x: improvement, trend only
+        tpu_2pc4_states_per_sec=50.0,  # never validated: no trend
+    )
+    details = json.load(open(os.environ["BENCH_DETAILS_FILE"]))
+    trend = {e["config"]: e for e in details["trend"]}
+    assert set(trend) == {
+        "tpu_paxos3_states_per_sec", "tpu_2pc7_states_per_sec"
+    }
+    assert trend["tpu_2pc7_states_per_sec"]["ratio"] == 1.5
+    assert [e["config"] for e in details["regressed"]] == [
+        "tpu_paxos3_states_per_sec"
+    ]
+    # trend is a details-artifact field, never a headline-line key
+    assert "trend" not in _lines(capsys)[-1]
+    # stale runs: no trend (nothing was measured)
+    b2 = _load_bench()
+    b2.VALIDATED.update({
+        "tpu_paxos3_states_per_sec": 200_000.0,
+        "validated_at": "2025-01-01T00:00:00Z",
+    })
+    b2.emit(cpu_paxos3_states_per_sec=8000.0)
+    details = json.load(open(os.environ["BENCH_DETAILS_FILE"]))
+    assert "trend" not in details
+
+
+def test_record_validated_embeds_the_run_report(bench_env):
+    """A validated full run persists its embedded tpu_paxos3_report into
+    BENCH_VALIDATED.json — the baseline half of ``regress.py --diff``
+    (pre-registry baselines simply lack the key)."""
+    b = _load_bench()
+    rep = {"v": 1, "model": "PaxosModel",
+           "config": {"key": "k"}, "totals": {"unique": 42}}
+    b.EXTRAS.update({
+        "tpu_paxos3_states_per_sec": 250_000.0,
+        "tpu_paxos2_discoveries": ["value chosen"],
+        "tpu_2pc5_discoveries": ["abort agreement"],
+        "tpu_paxos3_report": rep,
+    })
+    b.record_validated()
+    doc = json.load(open(os.environ["BENCH_VALIDATED_FILE"]))
+    assert doc["tpu_paxos3_report"] == rep
+
+
+def test_main_consumes_run_ledger_env_no_double_record(
+    bench_env, monkeypatch
+):
+    """main() CONSUMES STATERIGHT_TPU_RUN_DIR into RUN_LEDGER_DIR (every
+    process: parent/child/probe/ab-table): legs register explicitly and
+    leg-tagged via _register, and with the env knob gone the checkers'
+    join-time auto-record cannot double-archive the same run_id (which
+    would also pollute the index with untagged warm-up/CPU records)."""
+    import sys
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.telemetry.registry import RunRegistry
+
+    ledger = str(bench_env / "ledger")
+    monkeypatch.setenv("STATERIGHT_TPU_RUN_DIR", ledger)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--tpu-probe"])
+    b = _load_bench()
+    assert b.main() == 0  # the probe path runs main()'s consumption
+    assert b.RUN_LEDGER_DIR == ledger
+    assert "STATERIGHT_TPU_RUN_DIR" not in os.environ
+    # a post-consumption checker run does NOT auto-record...
+    c = TwoPhaseSys(2).checker().spawn_tpu(
+        sync=True, capacity=1 << 11, batch=64
+    )
+    c.join()
+    assert RunRegistry(ledger).index() == []
+    # ...and the explicit leg registration is the single, tagged record
+    RunRegistry(b.RUN_LEDGER_DIR).record(c, leg="2pc2")
+    idx = RunRegistry(ledger).index()
+    assert [(r["run_id"], r.get("leg")) for r in idx] == [(c.run_id, "2pc2")]

@@ -440,7 +440,13 @@ def test_checker_health_surface_end_to_end():
 
 
 def _strip_stamp(text: str) -> str:
-    return re.sub(r'"generated_at": "[^"]*"', '"generated_at": "X"', text)
+    # the volatile header is stripped BY SCHEMA (report.VOLATILE_KEYS):
+    # a new volatile identity field added there is covered here for free
+    from stateright_tpu.telemetry.report import VOLATILE_KEYS
+
+    for k in VOLATILE_KEYS:
+        text = re.sub(rf'"{k}": "[^"]*"', f'"{k}": "X"', text)
+    return text
 
 
 def test_report_json_is_byte_stable_across_runs(tmp_path):
@@ -453,9 +459,16 @@ def test_report_json_is_byte_stable_across_runs(tmp_path):
     a = run(tmp_path / "a.json")
     b = run(tmp_path / "b.json")
     assert _strip_stamp(a) == _strip_stamp(b)
-    # the stamp is the ONLY volatile field, and it is a single header
+    # the volatile fields are EXACTLY the identity header, leading the
+    # document (report.VOLATILE_KEYS is the schema the diff engine
+    # scrubs by)
+    from stateright_tpu.telemetry.report import VOLATILE_KEYS
+
     doc = json.loads(a)
+    head = [k for k in doc if k in VOLATILE_KEYS]
+    assert list(doc)[: len(head)] == head
     assert list(doc)[0] == "generated_at"
+    assert "run_id" in head
 
 
 def test_report_contents_and_markdown(tmp_path):

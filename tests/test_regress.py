@@ -571,3 +571,75 @@ def test_roofline_section_gates_fresh_runs_only(tmp_path, capsys):
     base.write_text(json.dumps({**BASELINE, "tpu_paxos3_roofline": roof}))
     rc, v = run(good, "--roofline")
     assert rc == 0 and v["roofline"]["baseline_present"] is True
+
+
+def test_diff_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--diff: the contract-aware report diff (telemetry/diff.py).
+    Engages only when BOTH run and baseline embed a tpu_paxos3_report —
+    stale artifacts and pre-registry baselines never trip; a matching
+    pair passes; drifted counts under a count-identical contract fail;
+    incomparable pairs (prefix run vs stored full enumeration) are
+    disclosed and skipped."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # pre-registry: no report
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    cfg = {
+        "model": "PaxosModel", "instance": {"sig": "abc", "target": None},
+        "engine": "wavefront", "encoding": None,
+        "flags": {"por": False}, "device": "cpu", "git_rev": "deadbeef",
+        "key": "k1",
+    }
+    rep = {
+        "v": 1, "model": "PaxosModel", "engine": "wavefront",
+        "config": cfg,
+        "totals": {"states": 4_814_218, "unique": 1_194_428,
+                   "max_depth": 26, "done": True},
+        "properties": [
+            {"name": "value chosen", "expectation": "sometimes",
+             "discovery": True},
+        ],
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_paxos3_report": rep}
+    # pre-registry baseline (no embedded report) never trips
+    rc, v = run(good, "--diff")
+    assert rc == 0 and v["ok"] is True
+    assert v["diff"]["ok"] is True and "skipped" in v["diff"]
+    assert v["diff"]["baseline_present"] is False
+    # matching pair -> IDENTICAL, ok
+    base.write_text(json.dumps({**BASELINE, "tpu_paxos3_report": rep}))
+    rc, v = run(good, "--diff")
+    assert rc == 0 and v["diff"]["verdict"] == "IDENTICAL"
+    # drifted counts under a count-identical contract -> exit 1 with the
+    # violation named
+    drifted = json.loads(json.dumps(rep))
+    drifted["totals"]["unique"] -= 7
+    rc, v = run({**good, "tpu_paxos3_report": drifted}, "--diff")
+    assert rc == 1 and v["diff"]["verdict"] == "DIVERGENT"
+    assert any(x["rule"] == "counts_must_match"
+               for x in v["diff"]["violations"])
+    # incomparable (prefix run: different instance target) -> disclosed,
+    # skipped, rc 0
+    prefix = json.loads(json.dumps(rep))
+    prefix["config"]["instance"]["target"] = 4000
+    prefix["totals"]["unique"] = 4000
+    prefix["totals"]["states"] = 16000
+    rc, v = run({**good, "tpu_paxos3_report": prefix}, "--diff")
+    assert rc == 0 and v["diff"]["ok"] is True
+    assert "skipped" in v["diff"]
+    assert v["diff"]["contract"] == "incomparable"
+    # staleness still exits 2 regardless
+    rc, v = run({"fresh": False, "tpu_paxos3_report": rep}, "--diff")
+    assert rc == 2
+    # --allow-stale: reported, never gated
+    rc, v = run({"fresh": False, "tpu_paxos3_report": drifted},
+                "--diff", "--allow-stale")
+    assert rc == 0 and v["diff"]["verdict"] == "DIVERGENT"
