@@ -20,6 +20,9 @@ Layers (bottom-up, mirroring the reference's layer map in SURVEY.md §1):
  - :mod:`.semantics` — linearizability / sequential consistency testers.
  - :mod:`.models` — example systems (2PC, Paxos, registers, counters).
  - :mod:`.explorer` — web UI for interactive state-space browsing.
+ - :mod:`.checkpoint`, :mod:`.supervisor`, :mod:`.testing` — crash-safe
+   autosave generations, supervised runs with retry/backoff, and the
+   deterministic fault-injection layer (docs/robustness.md).
 """
 
 from .core import Expectation, Model, Property
@@ -32,6 +35,7 @@ from .checker import (
 )
 from .fingerprint import fingerprint, stable_hash
 from .analysis import AuditError, AuditFinding, AuditReport, audit_model
+from .supervisor import supervise
 
 __version__ = "0.1.0"
 
@@ -50,4 +54,5 @@ __all__ = [
     "AuditFinding",
     "AuditReport",
     "audit_model",
+    "supervise",
 ]

@@ -48,6 +48,9 @@ class CheckerBuilder:
         self.por_mode: Optional[bool] = None
         # billion-state spill tier (docs/spill.md); None = env default
         self.spill_mode: Optional[bool] = None
+        # periodic crash-safe autosave (stateright_tpu/checkpoint.py,
+        # docs/robustness.md); None = env default (STATERIGHT_TPU_AUTOSAVE)
+        self.autosave_opts: Optional[dict] = None
 
     # -- configuration -------------------------------------------------------
 
@@ -370,6 +373,40 @@ class CheckerBuilder:
         (host-tier budget before the disk tier takes over; env
         ``STATERIGHT_TPU_HOST_BYTES``)."""
         self.spill_mode = bool(enabled)
+        return self
+
+    def autosave(
+        self,
+        path: str,
+        every_secs: float = 60.0,
+        keep: int = 3,
+    ) -> "CheckerBuilder":
+        """Periodically autosave the run to rotating snapshot generations
+        under ``path`` (``stateright_tpu/checkpoint.py``;
+        docs/robustness.md): at host-sync boundaries, once ``every_secs``
+        has elapsed (``0`` = every host sync), the device engines write
+        their resume snapshot as ``gen-NNNNNN/snapshot.npz`` + a
+        ``MANIFEST.json`` committed LAST — both through the atomic write
+        discipline (tmp + fsync + ``os.replace``), so a crash mid-save
+        leaves a torn generation that resume detects and skips, never a
+        poisoned one.  The newest ``keep`` complete generations are
+        retained.
+
+        Resume with ``spawn_tpu(resume=checkpoint.latest_generation(DIR)
+        [0])`` — or run under ``supervisor.supervise``, which wires
+        autosave + classify + retry/backoff end to end.  Each save emits
+        a versioned ``checkpoint`` ring record and a ``stage_checkpoint``
+        attribution counter, so the cadence's cost is visible in the
+        stage breakdown.  Contract (the registry's form, pinned): on or
+        off, the step jaxpr is bit-identical and the engine cache
+        unkeyed — autosave is pure host-side I/O at sync boundaries.
+        Env equivalent: ``STATERIGHT_TPU_AUTOSAVE=DIR`` (cadence/keep
+        via ``STATERIGHT_TPU_AUTOSAVE_SECS``/``_KEEP``)."""
+        self.autosave_opts = {
+            "dir": str(path),
+            "every_secs": float(every_secs),
+            "keep": int(keep),
+        }
         return self
 
     def checked(self, enabled: bool = True) -> "CheckerBuilder":

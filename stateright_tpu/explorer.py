@@ -211,6 +211,7 @@ def _metrics_view(checker) -> Optional[dict]:
     rec = getattr(checker, "flight_recorder", None)
     if rec is None:
         return None
+    dur_fn = getattr(checker, "durability_status", None)
     steps = rec.records("step")[-120:]
     series: dict = {
         "t": [], "states_per_sec": [], "unique": [], "load_factor": [],
@@ -248,6 +249,15 @@ def _metrics_view(checker) -> Optional[dict]:
         # .telemetry(roofline=True).  The UI's stage-roofline panel
         # reads it.
         "roofline": rec.roofline(),
+        # durability block (stateright_tpu/checkpoint.py + supervisor.py,
+        # docs/robustness.md): autosave cadence/generations/last-
+        # checkpoint-age + supervised restart count; null unless the run
+        # has autosave armed or a supervision trail.  Read LIVE off the
+        # checker (the age ticks between autosaves); the recorder's
+        # snapshot is the fallback for replayed recorders.
+        "durability": (
+            (dur_fn() if callable(dur_fn) else None) or rec.durability()
+        ),
     }
 
 

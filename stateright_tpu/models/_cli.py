@@ -45,6 +45,7 @@ def run_cli(
     capacity: Optional[Callable[[list], None]] = None,
     costmodel: Optional[Callable[[list], None]] = None,
     compare: Optional[Callable[[list], None]] = None,
+    supervise: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -80,6 +81,8 @@ def run_cli(
         costmodel(rest)
     elif cmd == "compare" and compare is not None:
         compare(rest)
+    elif cmd == "supervise" and supervise is not None:
+        supervise(rest)
     else:
         print("USAGE:")
         print(usage)
@@ -116,6 +119,13 @@ def run_cli(
                   "[--expect=VERDICT]  # contract-aware run diff: "
                   "report files or registry run ids "
                   "(docs/telemetry.md \"Comparing runs\")")
+        if supervise is not None:
+            print("  <example> supervise [ARGS] --autosave=DIR "
+                  "[--every=SECS] [--keep=K] [--max-restarts=N] "
+                  "[--runs=DIR] [--batch=N] [--steps=N] "
+                  "[--fault-plan=F] [--fault-log=F]  "
+                  "# supervised run: periodic atomic checkpoints + "
+                  "retry/backoff resume (docs/robustness.md)")
 
 
 def pop_checked(rest: list) -> tuple:
@@ -243,6 +253,19 @@ def watch_line(checker) -> str:
     sp = _watch_spill(rec)
     if sp:
         parts.append(f"spill={sp}")
+    dur_fn = getattr(checker, "durability_status", None)
+    dur = dur_fn() if callable(dur_fn) else None
+    if dur:
+        auto = dur.get("autosave") or {}
+        age = auto.get("last_checkpoint_age_secs")
+        if auto:
+            parts.append(
+                "ckpt=" + ("-" if age is None else f"{age:.0f}s")
+            )
+        if dur.get("restarts"):
+            parts.append(f"restarts={dur['restarts']}")
+    if h.get("spill_degraded"):
+        parts.append("SPILL-DEGRADED(disk tier lost; host RAM only)")
     if h.get("stalled"):
         parts.append(f"STALLED({h.get('stall_reason') or '?'})")
     if h.get("oom_risk"):
@@ -1041,6 +1064,109 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
                 file=stream,
             )
     return 0
+
+
+# -- supervise verb (supervisor.py; docs/robustness.md) ----------------------
+
+
+def pop_supervise_opts(rest: list) -> tuple:
+    """Strip the supervise verb's flags: ``(opts, rest)``.  ``opts``
+    carries ``autosave`` (dir; a temp dir when omitted, printed so the
+    operator can resume), ``every``/``keep`` (cadence), ``max_restarts``,
+    ``runs`` (registry dir), and ``fault_plan``/``fault_log`` (chaos:
+    a JSON FaultPlan to install, and where to dump its fired trail)."""
+    opts = {
+        "autosave": None, "every": 60.0, "keep": 3, "max_restarts": 5,
+        "runs": None, "fault_plan": None, "fault_log": None,
+        "batch": None, "steps": None,
+    }
+    kept = []
+    for a in rest:
+        if a.startswith("--autosave="):
+            opts["autosave"] = a[len("--autosave="):]
+        elif a.startswith("--batch="):
+            opts["batch"] = int(a[len("--batch="):])
+        elif a.startswith("--steps="):
+            opts["steps"] = int(a[len("--steps="):])
+        elif a.startswith("--every="):
+            opts["every"] = float(a[len("--every="):])
+        elif a.startswith("--keep="):
+            opts["keep"] = int(a[len("--keep="):])
+        elif a.startswith("--max-restarts="):
+            opts["max_restarts"] = int(a[len("--max-restarts="):])
+        elif a.startswith("--runs="):
+            opts["runs"] = a[len("--runs="):]
+        elif a.startswith("--fault-plan="):
+            opts["fault_plan"] = a[len("--fault-plan="):]
+        elif a.startswith("--fault-log="):
+            opts["fault_log"] = a[len("--fault-log="):]
+        else:
+            kept.append(a)
+    return opts, kept
+
+
+def run_supervised(builder, opts: dict, stream=None, **spawn_kw):
+    """Drive one supervised run (``supervisor.supervise``) from a
+    :func:`pop_supervise_opts` config; prints the one-line summary the
+    CI chaos smoke greps and returns the :class:`SupervisedRun`."""
+    from ..supervisor import supervise
+    from ..testing.faults import FaultPlan
+
+    stream = stream or sys.stdout
+    if opts.get("autosave") is None:
+        import tempfile
+
+        opts = dict(opts)
+        opts["autosave"] = tempfile.mkdtemp(
+            prefix="stateright-tpu-autosave-"
+        )
+        print(
+            f"supervise: no --autosave=DIR given; checkpointing into "
+            f"{opts['autosave']} (pass the same dir to resume after a "
+            "kill)",
+            file=stream,
+        )
+    plan = None
+    if opts.get("fault_plan"):
+        plan = FaultPlan.from_file(opts["fault_plan"]).install()
+    if opts.get("runs"):
+        builder = builder.runs(opts["runs"])
+    # a recorder is required for the checkpoint/restart ring records (and
+    # costs nothing measurable; the telemetry overhead contract)
+    if builder.telemetry_opts is None:
+        builder = builder.telemetry()
+    if opts.get("batch"):
+        spawn_kw.setdefault("batch", int(opts["batch"]))
+    if opts.get("steps"):
+        spawn_kw.setdefault("steps_per_call", int(opts["steps"]))
+    try:
+        res = supervise(
+            builder,
+            autosave_dir=opts["autosave"],
+            every_secs=float(opts.get("every", 60.0)),
+            keep=int(opts.get("keep", 3)),
+            max_restarts=int(opts.get("max_restarts", 5)),
+            **spawn_kw,
+        )
+    finally:
+        if plan is not None:
+            plan.uninstall()
+            if opts.get("fault_log"):
+                plan.to_jsonl(opts["fault_log"])
+    c = res.checker
+    parent = getattr(c, "parent_run_id", None)
+    print(
+        f"supervised: done={c.is_done()} states={c.state_count()} "
+        f"unique={c.unique_state_count()} restarts={res.restarts} "
+        f"run_id={c.run_id}"
+        + (f" parent_run_id={parent}" if parent else "")
+        + (
+            f" degradations={','.join(res.degradations)}"
+            if res.degradations else ""
+        ),
+        file=stream,
+    )
+    return res
 
 
 # -- profile verb ------------------------------------------------------------

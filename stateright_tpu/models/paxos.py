@@ -50,8 +50,10 @@ from ._cli import (
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_supervise_opts,
     pop_watch,
     run_cli,
+    run_supervised,
     spawn_watched,
 )
 
@@ -354,6 +356,15 @@ def main(argv=None):
         print(f"Exploring Paxos state space with {client_count} clients on {addr}.")
         paxos_model(client_count, 3).checker().serve(addr)
 
+    def supervise(rest):
+        opts, rest = pop_supervise_opts(rest)
+        client_count = int(rest[0]) if rest else 2
+        print(
+            f"Supervised Paxos check with {client_count} clients "
+            "(autosave + retry/backoff; docs/robustness.md)."
+        )
+        run_supervised(paxos_model(client_count, 3).checker(), opts)
+
     def spawn_cmd(rest):
         from ..actor import spawn
 
@@ -392,6 +403,7 @@ def main(argv=None):
         capacity=make_capacity_cmd(_audit_models),
         costmodel=make_costmodel_cmd(_audit_models),
         compare=make_compare_cmd(),
+        supervise=supervise,
         argv=argv,
     )
 

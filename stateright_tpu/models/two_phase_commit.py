@@ -38,8 +38,10 @@ from ._cli import (
     make_sanitize_cmd,
     pop_checked,
     pop_perf,
+    pop_supervise_opts,
     pop_watch,
     run_cli,
+    run_supervised,
     spawn_watched,
 )
 
@@ -450,6 +452,15 @@ def main(argv=None):
         print(f"Exploring 2PC state space with {rm_count} RMs on {addr}.")
         TwoPhaseSys(rm_count).checker().serve(addr)
 
+    def supervise(rest):
+        opts, rest = pop_supervise_opts(rest)
+        rm_count = int(rest[0]) if rest else 2
+        print(
+            f"Supervised 2PC check with {rm_count} RMs "
+            "(autosave + retry/backoff; docs/robustness.md)."
+        )
+        run_supervised(TwoPhaseSys(rm_count).checker(), opts)
+
     run_cli(
         "  two_phase_commit check [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-sym [RESOURCE_MANAGER_COUNT]\n"
@@ -471,6 +482,7 @@ def main(argv=None):
         capacity=make_capacity_cmd(_audit_models),
         costmodel=make_costmodel_cmd(_audit_models),
         compare=make_compare_cmd(),
+        supervise=supervise,
         argv=argv,
     )
 

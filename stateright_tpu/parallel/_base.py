@@ -209,6 +209,29 @@ class WavefrontChecker(Checker):
             # the informational spill forecast — the run will not OOM at
             # the wall, it will evict (telemetry/health.py)
             self.flight_recorder.set_spill_armed(True)
+        # crash-safe autosave (stateright_tpu/checkpoint.py,
+        # docs/robustness.md): rotating atomic snapshot generations written
+        # at host-sync boundaries.  Pure host-side I/O — the step jaxpr and
+        # the engine cache are untouched either way (pinned by test).  The
+        # supervision trail (restart count, degradation events) rides the
+        # builder when supervisor.supervise drives the run.
+        self._restarts = int(
+            getattr(options, "_supervise_restarts", 0) or 0
+        )
+        self._degradations = list(
+            getattr(options, "_supervise_degradations", None) or []
+        )
+        self._autosave = None
+        from ..checkpoint import AutosaveService, resolve_autosave
+
+        aopts = resolve_autosave(getattr(options, "autosave_opts", None))
+        if aopts is not None:
+            self._autosave = AutosaveService(
+                aopts["dir"], aopts["every_secs"], aopts["keep"],
+                recorder=self.flight_recorder,
+            )
+        self._autosave_config = None  # build_config cache (per checker)
+        self._refresh_durability()
         # HBM memory ledger (telemetry/memory.py): per-buffer analytic
         # accounting + growth-transient forecast + live device readings.
         # Pure host arithmetic over shapes the engines already know —
@@ -497,6 +520,123 @@ class WavefrontChecker(Checker):
         if transferred:
             rec.add_bytes(d2h=arr.nbytes)
         rec.record("occupancy", at=at, **occupancy_stats(arr))
+
+    # -- autosave + durability (stateright_tpu/checkpoint.py) ----------------
+
+    def _autosave_manifest(self, snap: dict) -> dict:
+        """The generation manifest: run identity + canonical config +
+        checkpoint-time progress.  Self-describing enough that (a) resume
+        picks generations without loading npz payloads and (b) the
+        supervisor can archive a stub report for a run killed before its
+        own ``join()`` (``checkpoint.stub_report_doc``)."""
+        import datetime
+
+        if self._autosave_config is None:
+            from ..telemetry.report import build_config
+
+            try:
+                self._autosave_config = build_config(self)
+            except Exception:  # noqa: BLE001 - identity must never
+                self._autosave_config = {}  # break a checkpoint
+        disc = np.asarray(snap.get("disc", np.zeros(0))).reshape(-1)
+        props = []
+        for i, p in enumerate(self._props):
+            props.append({
+                "name": p.name,
+                "expectation": getattr(
+                    p.expectation, "name", str(p.expectation)
+                ).lower(),
+                "discovery": bool(
+                    i < disc.size and int(disc[i]) != 0
+                ),
+            })
+        tag = (
+            "wavefront" if self._engine_tag == "single"
+            else self._engine_tag
+        )
+        man = {
+            "run_id": self.run_id,
+            "model": type(self.model).__name__,
+            "engine": tag,
+            "config": self._autosave_config,
+            "totals": {
+                "states": int(np.asarray(snap.get("scount", 0))),
+                "unique": int(np.asarray(snap.get("unique", 0))),
+                "max_depth": int(np.asarray(
+                    snap.get("maxdepth", snap.get("depth", 0))
+                )),
+            },
+            "properties": props,
+            "restarts": self._restarts,
+            "written_at": datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+        }
+        if self.parent_run_id:
+            man["parent_run_id"] = self.parent_run_id
+        return man
+
+    def _maybe_autosave(self, snap_fn, force: bool = False) -> None:
+        """Write one autosave generation when the cadence is due (or
+        ``force`` — the preemption-stop path snapshots uncondition-
+        ally so a cooperative SIGTERM loses ~zero work).  ``snap_fn`` is
+        a zero-arg thunk building the engine snapshot, called only when
+        a save actually happens."""
+        svc = self._autosave
+        if svc is None or not (force or svc.due()):
+            return
+        import time as _time
+
+        t0 = _time.monotonic()
+        try:
+            snap = snap_fn()
+            svc.save(snap, self._autosave_manifest(snap))
+        except Exception as e:  # noqa: BLE001 - checkpointing must never
+            # kill the run it protects; OSErrors are handled (and warned
+            # about) inside save(), anything else is accounted here
+            from ..testing.faults import InjectedFault
+
+            if isinstance(e, InjectedFault):
+                # a scheduled chaos kill/oom at the snapshot seam must
+                # reach the supervisor's classifier, not be swallowed —
+                # it is manufactured process death, not a write failure
+                raise
+            svc._clock = _time.monotonic()  # a failing path must not
+            # turn every subsequent sync into a fresh attempt
+            svc.note_failure(svc._gen, e)
+        self._stage("checkpoint", _time.monotonic() - t0)
+        self._refresh_durability()
+
+    def durability_status(self, live: bool = True) -> Optional[dict]:
+        """The durability block (docs/robustness.md), or None when the
+        run has neither autosave armed nor a supervision trail.
+        ``live=False`` returns the DETERMINISTIC subset the run report
+        embeds: the configured cadence, the restart count, and the
+        degradation events — generation counts and checkpoint ages are
+        wall-clock-shaped and stay in the live view (markdown /
+        ``/.metrics`` / ``--watch``)."""
+        svc = self._autosave
+        if svc is None and not self._restarts and not self._degradations:
+            return None
+        from ..checkpoint import CKPT_V
+
+        out: dict = {"v": CKPT_V, "restarts": self._restarts}
+        if self._degradations:
+            out["degradations"] = list(self._degradations)
+        if svc is not None:
+            if live:
+                out["autosave"] = svc.status()
+            else:
+                out["autosave"] = {
+                    "every_secs": svc.every_secs,
+                    "keep": svc.keep,
+                }
+        return out
+
+    def _refresh_durability(self) -> None:
+        rec = self.flight_recorder
+        if rec is None:
+            return
+        rec.set_durability(self.durability_status())
 
     # -- stop/checkpoint protocol (engines define _final_snapshot and serve
     # _ckpt_req at their host sync points) -----------------------------------

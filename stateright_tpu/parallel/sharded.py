@@ -65,6 +65,7 @@ from ..checker.base import CheckerBuilder
 from ..core import Expectation
 from ..ops.buckets import SLOTS, bucket_insert, window_unique
 from ..ops.hashing import EMPTY, row_hash
+from ..testing import faults
 from ._base import WavefrontChecker
 from .prewarm import CompileWatch, donation_supported
 
@@ -1101,6 +1102,30 @@ class ShardedTpuChecker(WavefrontChecker):
         rec = self.flight_recorder
         occ_every = int(self._telemetry_opts.get("occupancy_every") or 0)
         syncs = 0
+        hs = 0  # host-sync ordinal for the chaos seam
+        # autosave is single-controller only, like checkpoint(): the full
+        # sharded carry is not addressable across hosts.  Disarm LOUDLY
+        # on a multi-controller run (the checkpoint() rule, minus the
+        # raise: autosave can arrive via the env knob, and killing an
+        # otherwise-valid run over an inapplicable checkpoint cadence
+        # would be worse than running without checkpoints) — and retract
+        # the durability block so the operator is never told checkpoints
+        # exist when none are being written
+        single_controller = jax.process_count() == 1
+        if not single_controller and self._autosave is not None:
+            import sys as _sys
+
+            print(
+                "stateright-tpu: autosave is single-controller only on "
+                "the sharded engine (the sharded carry is not "
+                "addressable across hosts); DISARMED for this run — no "
+                "checkpoints will be written and a preemption loses the "
+                "run. Pre-size capacity or run single-controller for "
+                "durable checkpoints.",
+                file=_sys.stderr,
+            )
+            self._autosave = None
+            self._refresh_durability()
         if rec is not None:
             rec.update_meta(
                 devices=self.ndev, steps_per_call=self._steps,
@@ -1283,12 +1308,28 @@ class ShardedTpuChecker(WavefrontChecker):
                                 "frontier_capacity": fcap * self.ndev,
                             },
                         )
+                # chaos seam (testing/faults.py): inert unless a FaultPlan
+                # is installed; host-side only, jaxpr untouched
+                faults.fire(
+                    "host_sync", recorder=rec, step=hs, unique=unique
+                )
+                hs += 1
                 if self._ckpt_req is not None and self._ckpt_req.is_set():
                     self._ckpt_out = self._carry_to_snapshot(
                         carry, more, cap, fcap, bf, cf
                     )
                     self._ckpt_req.clear()
                     self._ckpt_ready.set()
+                if single_controller:
+                    # periodic autosave (checkpoint.py) — single-controller
+                    # only, like checkpoint(): the full sharded carry is
+                    # not addressable across hosts
+                    self._maybe_autosave(
+                        lambda: self._carry_to_snapshot(
+                            carry, more, cap, fcap, bf, cf
+                        ),
+                        force=self._stop.is_set(),
+                    )
                 if status != _OK or not more or self._stop.is_set():
                     break
                 if self._profiler is not None:
@@ -1308,6 +1349,10 @@ class ShardedTpuChecker(WavefrontChecker):
                     "configuration actually reaches)."
                 )
             if status != _OK and not self._stop.is_set():
+                # chaos seam: growth boundaries are the device-OOM locus
+                faults.fire(
+                    "growth", recorder=rec, status=status, unique=unique
+                )
                 if rec is not None:
                     rec.record(
                         "growth", status=status_names.get(status, str(status)),

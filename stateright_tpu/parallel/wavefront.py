@@ -74,6 +74,7 @@ from ..ops.buckets import (
     window_unique,
 )
 from ..ops.hashing import EMPTY, row_hash
+from ..testing import faults
 from ._base import WavefrontChecker
 from .prewarm import CompileWatch, donation_supported
 
@@ -1182,11 +1183,20 @@ class TpuChecker(WavefrontChecker):
             "queue_offloaded": t["queue_offloaded"],
             "queue_refilled": t["queue_refilled"],
             "queue_host_rows": q_host,
+            **(
+                {"degraded": True,
+                 "degraded_reason": store.degraded_reason}
+                if store.degraded else {}
+            ),
         }
 
     def _refresh_spill(self) -> None:
         if self.flight_recorder is not None:
             self.flight_recorder.set_spill(self._spill_snapshot())
+            if self._spill_store.degraded:
+                # disk tier lost (ENOSPC/dead disk): the sticky
+                # ``spill_degraded`` health transition, emitted once
+                self.flight_recorder.set_spill_degraded()
 
     def spill_status(self) -> Optional[dict]:
         """Spill-tier status of this run, or None when ``spill()`` was
@@ -2036,6 +2046,7 @@ class TpuChecker(WavefrontChecker):
         rec = self.flight_recorder
         occ_every = int(self._telemetry_opts.get("occupancy_every") or 0)
         syncs = 0
+        hs = 0  # host-sync ordinal for the chaos seam (recorder-independent)
         disc_len = max(len(self._props), 1)
         cart_start = self._cart_start if self._cartography else None
         por_start = self._por_start if self._por else None
@@ -2116,6 +2127,10 @@ class TpuChecker(WavefrontChecker):
                         {"cap": cap, "qcap": qcap, "batch": batch},
                         extra={"queue_capacity": qcap},
                     )
+            # chaos seam (testing/faults.py): inert unless a FaultPlan is
+            # installed — host-side only, so the step jaxpr cannot change
+            faults.fire("host_sync", recorder=rec, step=hs, unique=unique)
+            hs += 1
             # serve a pending checkpoint BEFORE growing OR resolving: a
             # request landing on a growth boundary snapshots the boundary
             # carry (status != OK) and resume re-applies the growth; one
@@ -2126,6 +2141,14 @@ class TpuChecker(WavefrontChecker):
                 self._ckpt_out = self._carry_to_snapshot(carry, cap, qcap, cand)
                 self._ckpt_req.clear()
                 self._ckpt_ready.set()
+            # periodic autosave (stateright_tpu/checkpoint.py): when the
+            # cadence is due, this sync's carry lands as an atomic
+            # rotating generation — a boundary carry (status != OK) is a
+            # valid snapshot (resume re-applies the growth), so no status
+            # gate is needed
+            self._maybe_autosave(
+                lambda: self._carry_to_snapshot(carry, cap, qcap, cand)
+            )
             # spill pending resolution: every sync with deferred
             # candidates (and a table/queue the inject can write into —
             # growth boundaries resolve on the NEXT sync) looks them up
@@ -2151,6 +2174,12 @@ class TpuChecker(WavefrontChecker):
                     "configuration actually reaches)."
                 )
             if status != _STATUS_OK:
+                # chaos seam: a growth boundary is where device OOM
+                # strikes in the wild (the migration transient) — the
+                # chaos suite injects RESOURCE_EXHAUSTED exactly here
+                faults.fire(
+                    "growth", recorder=rec, status=status, unique=unique
+                )
                 t_grow = time.monotonic()
                 self.growth_events.append((status, unique))
                 if rec is not None:
@@ -2230,6 +2259,13 @@ class TpuChecker(WavefrontChecker):
                 stats = None
                 continue
             if self._stop.is_set():
+                # cooperative preemption (stop()/SIGTERM/deadline): one
+                # forced final generation so "stall => snapshot + yield
+                # the chip" loses at most the current steps block
+                self._maybe_autosave(
+                    lambda: self._carry_to_snapshot(carry, cap, qcap, cand),
+                    force=True,
+                )
                 break
             all_disc = bool(self._props) and bool((disc != 0).all())
             target_hit = self._target is not None and unique >= self._target

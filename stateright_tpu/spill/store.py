@@ -215,6 +215,13 @@ class SpillStore:
         self._ram_bytes = 0
         self._disk_bytes = 0
         self._closed = False
+        # disk-tier degradation (docs/robustness.md): a failed segment
+        # flush (ENOSPC, dead disk) warns once and pins the tier in host
+        # RAM — the run keeps its exactness guarantees, it just stops
+        # paging to disk.  Surfaces as ``degraded`` in the spill block
+        # and a ``spill_degraded`` health transition.
+        self.degraded = False
+        self.degraded_reason: Optional[str] = None
 
     # -- writing -------------------------------------------------------------
 
@@ -271,25 +278,58 @@ class SpillStore:
 
     def _flush_to_disk(self) -> None:
         n = sum(f.size for f, _ in self._ram)
-        if n == 0:
+        if n == 0 or self.degraded:
             return
-        if self._dir is None:
-            self._dir = tempfile.mkdtemp(prefix="stateright-tpu-spill-")
-            # self-created temp dirs are reclaimed at process exit even
-            # when no caller ever invokes close() — the segments are
-            # process-local scratch (snapshots carry portable arrays)
-            import atexit
+        try:
+            from ..testing import faults
 
-            atexit.register(self.close)
-        os.makedirs(self._dir, exist_ok=True)
-        path = os.path.join(self._dir, f"spill-{len(self._disk):04d}.bin")
-        mm = np.memmap(path, dtype=np.uint64, mode="w+", shape=(n, 2))
-        at = 0
-        for f, p in self._ram:
-            mm[at:at + f.size, 0] = f
-            mm[at:at + f.size, 1] = p
-            at += f.size
-        mm.flush()
+            faults.fire("spill_flush", entries=n)
+            if self._dir is None:
+                self._dir = tempfile.mkdtemp(prefix="stateright-tpu-spill-")
+                # self-created temp dirs are reclaimed at process exit even
+                # when no caller ever invokes close() — the segments are
+                # process-local scratch (snapshots carry portable arrays)
+                import atexit
+
+                atexit.register(self.close)
+            os.makedirs(self._dir, exist_ok=True)
+            path = os.path.join(
+                self._dir, f"spill-{len(self._disk):04d}.bin"
+            )
+            # atomic segment write (telemetry/_atomic.py — the package's
+            # ONE crash-write discipline, streamed so the payload is
+            # never doubled in RAM): a crash mid-flush leaves no
+            # half-segment at the final path, and an ENOSPC lands HERE
+            # (where it degrades) instead of as a SIGBUS on a later mmap
+            # page-in of a sparse file
+            from ..telemetry._atomic import atomic_write_stream
+
+            atomic_write_stream(
+                path,
+                (
+                    np.ascontiguousarray(
+                        np.stack([f, p], axis=1)
+                    ).tobytes()
+                    for f, p in self._ram
+                ),
+            )
+            mm = np.memmap(path, dtype=np.uint64, mode="r", shape=(n, 2))
+        except OSError as e:
+            # disk full / dead disk: warn ONCE, pin the tier in host RAM
+            # and keep running — losing the disk tier costs capacity
+            # headroom, never correctness (the index + RAM segments are
+            # intact), and crashing the run here would lose everything
+            self.degraded = True
+            self.degraded_reason = f"{type(e).__name__}: {e}"
+            import sys
+
+            print(
+                "stateright-tpu: spill: disk-segment flush failed "
+                f"({self.degraded_reason}); the spill tier stays in "
+                "host RAM (degraded — no further disk flushes this run)",
+                file=sys.stderr,
+            )
+            return
         self._disk.append(mm)
         self._disk_paths.append(path)
         self._disk_bytes += n * BYTES_PER_ENTRY

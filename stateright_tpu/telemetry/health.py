@@ -67,6 +67,10 @@ class HealthTracker:
         # boundary, not die — so it surfaces as ``spill_forecast``
         # instead of ``growth_oom_risk`` (recorder.set_spill_armed)
         self.spill_armed = False
+        # spill disk tier lost (ENOSPC/dead disk; docs/robustness.md):
+        # sticky for the run — the tier is pinned in host RAM, so
+        # capacity headroom shrank (recorder.set_spill_degraded)
+        self.spill_degraded = False
         self._mem_next_transient: Optional[int] = None
         self._mem_budget: Optional[int] = None
         self._zero_novel = 0  # consecutive d_unique == 0 steps
@@ -199,6 +203,18 @@ class HealthTracker:
         }
         return [{"v": HEALTH_V, **e} for e in events]
 
+    def mark_spill_degraded(self) -> list:
+        """The spill store's disk tier failed (ENOSPC / dead disk): one
+        sticky ``spill_degraded`` transition — the run continues with the
+        tier pinned in host RAM, and the operator should know the
+        capacity headroom shrank."""
+        if self.spill_degraded:
+            return []
+        self.spill_degraded = True
+        return [{
+            "v": HEALTH_V, "event": "spill_degraded", "phase": self.phase,
+        }]
+
     def mark_done(self) -> list:
         """The run completed: close the phase timeline.  An active stall
         is closed first with its ``stall_cleared`` transition — consumers
@@ -276,6 +292,9 @@ class HealthTracker:
                 else {}
             ),
             "stalled": self.stalled,
+            **(
+                {"spill_degraded": True} if self.spill_degraded else {}
+            ),
             **(
                 {"stall_reason": self.stall_reason}
                 if self.stall_reason

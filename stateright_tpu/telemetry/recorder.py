@@ -7,7 +7,8 @@ Record schema (every record):
  - ``t``    — seconds since the recorder was created (monotonic clock)
  - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
    | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"memory"``
-   | ``"roofline"`` | ``"note"``
+   | ``"roofline"`` | ``"checkpoint"`` | ``"fault"`` | ``"restart"``
+   | ``"note"``
 
 ``step`` records additionally carry the engine tag and cumulative counters
 (``states``, ``unique``) plus derived per-step deltas (``d_states``,
@@ -98,6 +99,10 @@ class FlightRecorder:
         # static per-stage FLOPs/bytes + reconciliation + verdicts;
         # set once at spawn (the static model cannot change mid-run)
         self._roofline: Optional[dict] = None
+        # latest durability snapshot (stateright_tpu/checkpoint.py:
+        # autosave cadence/generations + supervised restart count); same
+        # outside-the-ring discipline
+        self._durability: Optional[dict] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -255,6 +260,30 @@ class FlightRecorder:
         with self._lock:
             self._health.spill_armed = bool(armed)
 
+    def set_spill_degraded(self) -> None:
+        """The spill store's disk tier failed (ENOSPC / dead disk,
+        docs/robustness.md): emit the sticky ``spill_degraded`` health
+        transition (once) — the tier is pinned in host RAM."""
+        with self._lock:
+            for ev in self._health.mark_spill_degraded():
+                self._append_unlocked("health", ev)
+
+    def set_durability(self, snap: Optional[dict]) -> None:
+        """Replace the latest durability snapshot
+        (``stateright_tpu/checkpoint.py`` autosave status + supervised
+        restart count; docs/robustness.md) — the outside-the-ring
+        discipline of the other feature blocks.  ``None`` clears it
+        (autosave disarmed after arming, e.g. the sharded engine's
+        multi-controller fence)."""
+        with self._lock:
+            self._durability = dict(snap) if snap else None
+
+    def durability(self) -> Optional[dict]:
+        """Latest durability snapshot, or None when the run has neither
+        autosave armed nor a supervision trail."""
+        with self._lock:
+            return dict(self._durability) if self._durability else None
+
     def health(self) -> dict:
         """Live progress/health snapshot (health.py): phase, stall flag,
         novelty rate, EWMA throughput, drain ETA."""
@@ -376,6 +405,9 @@ class FlightRecorder:
             memory = dict(self._memory) if self._memory else None
             spill = dict(self._spill) if self._spill else None
             roofline = dict(self._roofline) if self._roofline else None
+            durability = (
+                dict(self._durability) if self._durability else None
+            )
         occ = [r for r in recs if r["kind"] == "occupancy"]
         out: dict = {
             **meta,
@@ -416,6 +448,8 @@ class FlightRecorder:
             out["spill"] = spill
         if roofline is not None:
             out["roofline"] = roofline
+        if durability is not None:
+            out["durability"] = durability
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")
@@ -449,6 +483,8 @@ class FlightRecorder:
                 self._spill = dict(summary["spill"])
             if summary.get("roofline") and self._roofline is None:
                 self._roofline = dict(summary["roofline"])
+            if summary.get("durability") and self._durability is None:
+                self._durability = dict(summary["durability"])
             if summary.get("states") is not None and self._last_step:
                 last_t = self._last_step[0]
                 self._last_step = (

@@ -114,16 +114,36 @@ class RunRegistry:
 
         if body is None:
             body = build_report(checker)
-        run_id = checker.run_id
         doc = identity_doc(checker, body)
+        return self.record_doc(doc, checker=checker, leg=leg)
+
+    def record_doc(
+        self,
+        doc: dict,
+        *,
+        checker=None,
+        leg: Optional[str] = None,
+    ) -> dict:
+        """Archive an already-assembled report document (a ``run_id``-
+        bearing ``identity_doc``, or a checkpoint-derived stub for a run
+        killed before its own join — ``checkpoint.stub_report_doc``).
+
+        Crash-safe (docs/robustness.md): the archive lands via the
+        atomic replace write and the index line via the durable append
+        (``telemetry/_atomic.py``) — a killed writer can tear at most
+        the ledger's LAST line, which :meth:`index` skips on read, so
+        prior records are never lost and resume is never poisoned."""
+        from ._atomic import atomic_write_json, durable_append_line
+
+        run_id = doc.get("run_id")
+        if not run_id:
+            raise ValueError("report document carries no run_id")
         os.makedirs(self.runs_dir, exist_ok=True)
-        path = os.path.join(self.runs_dir, f"{run_id}.json")
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=1)
-            f.write("\n")
+        atomic_write_json(
+            os.path.join(self.runs_dir, f"{run_id}.json"), doc
+        )
         rec = index_record(doc, checker=checker, leg=leg)
-        with open(self.index_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        durable_append_line(self.index_path, json.dumps(rec))
         return rec
 
     # -- reading -------------------------------------------------------------

@@ -282,6 +282,16 @@ def build_report(checker) -> dict:
         sp = sp_fn()
         if sp is not None:
             out["spill"] = sp
+    # durability (stateright_tpu/checkpoint.py + supervisor.py,
+    # docs/robustness.md): the DETERMINISTIC subset only — the autosave
+    # cadence config, the supervised restart count, and the degradation
+    # events.  Generation counts / checkpoint ages are wall-clock-shaped
+    # and live in the markdown rendering, like throughput.
+    dur_fn = getattr(checker, "durability_status", None)
+    if callable(dur_fn):
+        dur = dur_fn(live=False)
+        if dur is not None:
+            out["durability"] = dur
     rec = getattr(checker, "flight_recorder", None)
     if rec is not None:
         growth = []
@@ -514,6 +524,34 @@ def render_markdown(report: dict, rec=None, roofline_live=None) -> str:
                 f"rows offloaded to host, {sp.get('queue_refilled')} "
                 "refilled"
             )
+    dur = report.get("durability")
+    if dur:
+        lines += ["", "## Durability", ""]
+        auto = dur.get("autosave")
+        if auto:
+            lines.append(
+                f"- autosave: every {auto.get('every_secs')}s, newest "
+                f"{auto.get('keep')} generations kept"
+                + (
+                    f" ({auto.get('generations')} written this run"
+                    + (
+                        f", last age {auto.get('last_checkpoint_age_secs')}s"
+                        if auto.get("last_checkpoint_age_secs") is not None
+                        else ""
+                    )
+                    + ")"
+                    if auto.get("generations") is not None
+                    else ""
+                )
+            )
+            if auto.get("failures"):
+                lines.append(
+                    f"- **{auto['failures']} checkpoint write(s) FAILED** "
+                    "— durability degraded (docs/robustness.md)"
+                )
+        lines.append(f"- supervised restarts: **{dur.get('restarts', 0)}**")
+        for d in dur.get("degradations", []):
+            lines.append(f"- degradation: `{d}`")
     timeline = report.get("health_timeline")
     if timeline:
         lines += ["", "## Health timeline (count-derived)", ""]
@@ -674,15 +712,27 @@ def write_report(checker, path: str) -> dict:
             f"report path {path!r} ends in .md — pass the JSON path; the "
             "markdown rendering lands next to it as <path-stem>.md"
         )
+    from ._atomic import atomic_write_json, atomic_write_text
+
     body = build_report(checker)
     doc = identity_doc(checker, body)
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
-        f.write("\n")
+    # atomic (docs/robustness.md): a crash mid-write leaves the previous
+    # report intact, never a torn JSON a later diff/regress gate chokes on
+    atomic_write_json(path, doc)
     md_path = os.path.splitext(path)[0] + ".md"
     rec = getattr(checker, "flight_recorder", None)
     roof_fn = getattr(checker, "roofline", None)
     roofline_live = roof_fn() if callable(roof_fn) else None
-    with open(md_path, "w") as f:
-        f.write(render_markdown(body, rec=rec, roofline_live=roofline_live))
+    # the live durability view (generation counts, checkpoint age) rides
+    # the markdown like the rest of the wall-clock data
+    md_body = dict(body)
+    dur_fn = getattr(checker, "durability_status", None)
+    if callable(dur_fn):
+        live_dur = dur_fn(live=True)
+        if live_dur is not None:
+            md_body["durability"] = live_dur
+    atomic_write_text(
+        md_path,
+        render_markdown(md_body, rec=rec, roofline_live=roofline_live),
+    )
     return body

@@ -1,0 +1,10 @@
+"""Test-support machinery shipped with the package (not test code).
+
+``stateright_tpu.testing.faults`` is the deterministic fault-injection
+layer the chaos suite and the CI chaos smoke drive
+(``docs/robustness.md``).
+"""
+
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

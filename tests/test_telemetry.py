@@ -389,8 +389,8 @@ def test_explorer_metrics_endpoint_shape():
     try:
         m = _get(server.addr, "/.metrics")
         assert sorted(m) == [
-            "cartography", "counters", "health", "memory", "occupancy",
-            "roofline", "series", "spill", "summary",
+            "cartography", "counters", "durability", "health", "memory",
+            "occupancy", "roofline", "series", "spill", "summary",
         ]
         series = m["series"]
         assert sorted(series) == [
@@ -407,6 +407,8 @@ def test_explorer_metrics_endpoint_shape():
         assert m["cartography"] is None
         assert m["memory"] is None
         assert m["roofline"] is None
+        # durability is null too: no autosave armed, no supervision trail
+        assert m["durability"] is None
         # the health snapshot is always present with telemetry on
         assert m["health"]["phase"] == "done"
         assert m["health"]["stalled"] is False

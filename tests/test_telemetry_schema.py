@@ -104,6 +104,25 @@ SCHEMA = {
         },
         {},
     ),
+    "checkpoint": (
+        # autosave generation writes (stateright_tpu/checkpoint.py,
+        # docs/robustness.md): ok=False records a degraded (failed)
+        # write — the run continues, the record discloses it
+        {"v": int, "gen": int, "ok": bool},
+        {"unique": int, "states": int, "secs": _REAL, "error": str},
+    ),
+    "fault": (
+        # a FaultPlan delivery (testing/faults.py): site + action + the
+        # occurrence ordinal it fired at — the chaos run's ring trail
+        {"v": int, "site": str, "action": str, "at": int},
+        {},
+    ),
+    "restart": (
+        # a supervised resume (supervisor.py): attempt ordinal + the
+        # failure class that caused it; parent_run_id links the lineage
+        {"v": int, "attempt": int, "reason": str},
+        {"parent_run_id": str, "degradation": str},
+    ),
     "memory": (
         # the HBM ledger's per-rung snapshot (telemetry/memory.py):
         # per-buffer analytic bytes + the growth-transient forecast;
@@ -244,6 +263,47 @@ def test_spill_records_match_the_golden_schema(tmp_path, monkeypatch):
     assert not problems, "\n".join(problems)
     # the summary carries the live spill block alongside memory/cartography
     assert lines[0]["summary"]["spill"]["spilled_fps"] > 0
+
+
+def test_checkpoint_fault_restart_records_match_the_golden_schema(tmp_path):
+    """A supervised chaos run (kill injected mid-flight, autosave every
+    sync) exercises the versioned ``checkpoint`` + ``restart`` record
+    kinds; the killed attempt's recorder carries the ``fault`` record.
+    Every record validates field-by-field like the rest of the export."""
+    from stateright_tpu.supervisor import supervise
+    from stateright_tpu.testing.faults import Fault, FaultPlan
+
+    killed_recs = []
+
+    def spawn(b, resume=None, **kw):
+        c = b.spawn_tpu(resume=resume, **kw)
+        killed_recs.append(c.flight_recorder)
+        return c
+
+    plan = FaultPlan([Fault(site="host_sync", action="kill", at=3)])
+    with plan:
+        res = supervise(
+            TwoPhaseSys(3).checker().telemetry(),
+            autosave_dir=str(tmp_path / "auto"), every_secs=0.0,
+            max_restarts=2, sleep=lambda s: None, spawn=spawn,
+            capacity=1 << 12, batch=64, steps_per_call=2,
+        )
+    assert res.restarts == 1
+    path = tmp_path / "export.jsonl"
+    res.checker.flight_recorder.to_jsonl(path)
+    # the fault record landed in the KILLED attempt's ring
+    killed_recs[0].to_jsonl(path, append=True)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines() if ln]
+    records = [ln for ln in lines if ln.get("kind") != "header"]
+    kinds = {r["kind"] for r in records}
+    for expect in ("checkpoint", "restart", "fault"):
+        assert expect in kinds, f"run did not exercise {expect!r} records"
+    problems = []
+    for r in records:
+        problems += _check_record(r)
+    assert not problems, "\n".join(problems)
+    # the summary carries the durability block alongside the others
+    assert lines[0]["summary"]["durability"]["restarts"] == 1
 
 
 def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
