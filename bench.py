@@ -50,6 +50,14 @@ breakdown in ``tpu_2pc7_spill``); ``regress.py --spill`` gates its
 well-formedness and count parity.  ``BENCH_SPILL_BUDGET`` overrides
 the computed budget.
 
+``BENCH_MXU=1`` adds the flag-gated MXU-recast legs (docs/roofline.md
+"Executing the hot-spot list"): the same paxos-3 and 2pc-7 configs
+with ``CheckerBuilder.mxu()`` armed, count parity ASSERTED, and the
+flagged roofline ledgers embedded as ``tpu_paxos3_mxu_roofline`` /
+``tpu_2pc7_mxu_roofline`` next to the same run's unflagged blocks —
+``regress.py --mxu`` gates the before/after pair (expand+queue charged
+bytes drop >=30% on paxos-3; a dot-class dedup-insert op on 2pc-7).
+
 Run ledger (docs/telemetry.md "Comparing runs"): with
 ``STATERIGHT_TPU_RUN_DIR`` set, EVERY device leg bench runs is archived
 into the persistent run registry (``telemetry/registry.py``) — one
@@ -1090,6 +1098,91 @@ def tpu_phase() -> dict:
         except Exception as e:  # noqa: BLE001 - the flag-gated leg must
             # never void the primary metric
             out["tpu_2pc7_spill_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
+    # flag-gated MXU-recast legs (BENCH_MXU=1; docs/roofline.md
+    # "Executing the hot-spot list"): the same paxos-3 and 2pc-7 configs
+    # with CheckerBuilder.mxu() armed — expand-scatter coalescing, slim
+    # queue traffic, and the BLEST one-hot probe.  Count parity against
+    # the unflagged legs is ASSERTED (a broken recast cannot report a
+    # win), and each leg embeds its FLAGGED roofline block
+    # (tpu_*_mxu_roofline) next to the same run's unflagged block —
+    # exactly the before/after pair regress.py --mxu gates: paxos-3
+    # expand+queue charged bytes must drop >=30%, and 2pc-7's
+    # dedup-insert stage must carry a dot-class op.
+    if os.environ.get("BENCH_MXU", "") == "1":
+        try:
+            _mark("compile (paxos3 mxu engine)")
+
+            def spawn3m():
+                # the A/B must be FLAG-only: same telemetry set as the
+                # unflagged leg (cartography rides the step program at
+                # the <=5% pin — dropping it here would inflate the
+                # recast's measured delta by the same magnitude)
+                b = m3.checker().mxu().telemetry(
+                    capacity=2048, cartography=True, memory=True,
+                    roofline=True,
+                )
+                if target:
+                    b = b.target_states(int(target))
+                return b.spawn_tpu(sync=True, **caps)
+
+            spawn3m()  # warm-up (compile)
+            tpu_m3, dt_m3 = timed(spawn3m)
+            if tpu_m3.unique_state_count() != tpu_p3.unique_state_count():
+                raise AssertionError(
+                    f"mxu paxos3 unique {tpu_m3.unique_state_count()} != "
+                    f"{tpu_p3.unique_state_count()}"
+                )
+            out["tpu_paxos3_mxu_states_per_sec"] = round(
+                tpu_m3.state_count() / dt_m3, 1
+            )
+            out["tpu_paxos3_mxu_unique"] = tpu_m3.unique_state_count()
+            out["tpu_paxos3_mxu_sec"] = round(dt_m3, 3)
+            roof_m3 = tpu_m3.roofline()
+            if roof_m3 is not None:
+                out["tpu_paxos3_mxu_roofline"] = roof_m3
+            _register(tpu_m3, "paxos3_mxu")
+            _mark("paxos3 mxu leg done")
+        except Exception as e:  # noqa: BLE001 - the flag-gated leg must
+            # never void the primary metric
+            out["tpu_paxos3_mxu_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+        try:
+            _mark("compile (2pc7 mxu engine)")
+            caps7m = dict(
+                capacity=1 << 21, queue_capacity=1 << 19, batch=2048,
+                steps_per_call=256, cand=1 << 15,
+            )
+            # flag-only A/B: telemetry set mirrors the unflagged leg
+            spawn7m = lambda: (  # noqa: E731
+                TwoPhaseSys(7).checker().mxu()
+                .telemetry(capacity=2048, cartography=True, memory=True,
+                           roofline=True)
+                .spawn_tpu(sync=True, **caps7m)
+            )
+            spawn7m()  # warm-up
+            tpu_m7, dt_m7 = timed(spawn7m)
+            if (
+                "tpu_2pc7_unique" in out
+                and tpu_m7.unique_state_count() != out["tpu_2pc7_unique"]
+            ):
+                raise AssertionError(
+                    f"mxu 2pc7 unique {tpu_m7.unique_state_count()} != "
+                    f"{out['tpu_2pc7_unique']}"
+                )
+            out["tpu_2pc7_mxu_states_per_sec"] = round(
+                tpu_m7.state_count() / dt_m7, 1
+            )
+            out["tpu_2pc7_mxu_unique"] = tpu_m7.unique_state_count()
+            out["tpu_2pc7_mxu_sec"] = round(dt_m7, 3)
+            roof_m7 = tpu_m7.roofline()
+            if roof_m7 is not None:
+                out["tpu_2pc7_mxu_roofline"] = roof_m7
+            _register(tpu_m7, "2pc7_mxu")
+            _mark("2pc7 mxu leg done")
+        except Exception as e:  # noqa: BLE001 - same never-void rule
+            out["tpu_2pc7_mxu_error"] = f"{type(e).__name__}: {e}"
         _persist(out)
 
     # reference bench protocol on device.  All five configs compile — the

@@ -8,7 +8,7 @@ staleness and regressions LOUD:
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
-                      [--memory] [--spill] [--roofline] [--diff]
+                      [--memory] [--spill] [--roofline] [--mxu] [--diff]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -477,6 +477,153 @@ def roofline_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+# the --mxu payoff bar (ISSUE 14 acceptance): with coalescing +
+# slim-queue on, paxos-3's expand+queue charged bytes must drop by at
+# least this fraction vs the same run's unflagged ledger
+MXU_EXPAND_QUEUE_DROP = 0.30
+
+
+def _stage_of(roof, name: str):
+    """One stage dict of a roofline block (None when the block, its
+    stages map, or the stage is missing or malformed — injected
+    artifacts are arbitrary JSON, so every level is checked)."""
+    if not isinstance(roof, dict):
+        return None
+    stages = roof.get("stages")
+    st = stages.get(name) if isinstance(stages, dict) else None
+    return st if isinstance(st, dict) else None
+
+
+def _stage_bytes(roof: dict, name: str):
+    """Charged bytes of one stage of a roofline block (None when the
+    block/stage is missing or malformed)."""
+    st = _stage_of(roof, name)
+    if st is None:
+        return None
+    br, bw = st.get("bytes_read"), st.get("bytes_written")
+    if not isinstance(br, int) or not isinstance(bw, int):
+        return None
+    return br + bw
+
+
+def mxu_verdict(run: dict, baseline: dict) -> dict:
+    """``--mxu``: the MXU-recast legs (docs/roofline.md "Executing the
+    hot-spot list").
+
+    The legs are FLAG-gated (``BENCH_MXU=1``), so absence never trips —
+    stale artifacts and pre-mxu baselines pass untouched (the spill-leg
+    rule; unit-tested with injected artifacts).  When a fresh run
+    carries them, the round's acceptance bars apply:
+
+     - a crashed leg (``tpu_paxos3_mxu_error``/``tpu_2pc7_mxu_error``)
+       is a gate failure, not a skip;
+     - count parity: ``tpu_paxos3_mxu_unique == tpu_paxos3_unique`` and
+       ``tpu_2pc7_mxu_unique == tpu_2pc7_unique`` whenever both sides
+       exist (a recast that changes counts is not a recast);
+     - measured payoff, against the SAME RUN's unflagged roofline
+       blocks: paxos-3's expand+queue charged bytes/step must drop by
+       >= ``MXU_EXPAND_QUEUE_DROP`` under the flag, and 2pc-7's flagged
+       dedup-insert stage must carry a dot-class op with raised
+       arithmetic intensity (the BLEST probe actually landed on the
+       MXU's op class).
+    """
+    out: dict = {}
+    problems = []
+    present = False
+    for leg in ("tpu_paxos3_mxu", "tpu_2pc7_mxu"):
+        err = run.get(f"{leg}_error")
+        if err:
+            present = True
+            problems.append(f"leg crashed: {leg}: {err}")
+    # count parity whenever both sides exist
+    for flagged, plain in (
+        ("tpu_paxos3_mxu_unique", "tpu_paxos3_unique"),
+        ("tpu_2pc7_mxu_unique", "tpu_2pc7_unique"),
+    ):
+        u_m, u_p = run.get(flagged), run.get(plain)
+        if isinstance(u_m, int):
+            present = True
+            if isinstance(u_p, int) and u_m != u_p:
+                problems.append(
+                    f"{flagged}={u_m} != {plain}={u_p} (the recasts must "
+                    "not change counts)"
+                )
+    # paxos-3 bytes-moved payoff vs the same-run unflagged block
+    roof_m = run.get("tpu_paxos3_mxu_roofline")
+    if roof_m is not None:
+        present = True
+        roof_p = run.get("tpu_paxos3_roofline")
+        eq_m = _stage_bytes(roof_m, "expand")
+        qq_m = _stage_bytes(roof_m, "queue")
+        eq_p = _stage_bytes(roof_p, "expand") if roof_p else None
+        qq_p = _stage_bytes(roof_p, "queue") if roof_p else None
+        if None in (eq_m, qq_m):
+            problems.append(
+                "tpu_paxos3_mxu_roofline expand/queue stages malformed"
+            )
+        elif None in (eq_p, qq_p):
+            problems.append(
+                "no same-run unflagged tpu_paxos3_roofline to compare "
+                "the flagged ledger against"
+            )
+        else:
+            before, after = eq_p + qq_p, eq_m + qq_m
+            drop = 1.0 - after / before if before else 0.0
+            out["paxos3_expand_queue_bytes"] = {
+                "unflagged": before, "mxu": after,
+                "drop": round(drop, 4),
+            }
+            if drop < MXU_EXPAND_QUEUE_DROP:
+                problems.append(
+                    f"paxos-3 expand+queue charged bytes dropped only "
+                    f"{drop:.1%} under --mxu (< "
+                    f"{MXU_EXPAND_QUEUE_DROP:.0%} bar): coalescing/"
+                    "slim-queue did not execute the hot-spot list"
+                )
+    # 2pc-7 probe payoff: a genuine dot-class dedup-insert op
+    roof7_m = run.get("tpu_2pc7_mxu_roofline")
+    if roof7_m is not None:
+        present = True
+        st = _stage_of(roof7_m, "dedup-insert") or {}
+        classes = st.get("classes")
+        dot = classes.get("dot") if isinstance(classes, dict) else None
+        dot = dot if isinstance(dot, dict) else {}
+        if not isinstance(dot.get("flops"), int) or dot["flops"] <= 0:
+            problems.append(
+                "tpu_2pc7_mxu_roofline dedup-insert carries no dot-class "
+                "op (the BLEST probe did not land)"
+            )
+        else:
+            out["tpu_2pc7_dedup_dot_flops"] = dot["flops"]
+            ai_m = st.get("intensity")
+            ai_p = (
+                _stage_of(run.get("tpu_2pc7_roofline"), "dedup-insert")
+                or {}
+            ).get("intensity")
+            if (
+                isinstance(ai_m, (int, float))
+                and isinstance(ai_p, (int, float))
+                and not ai_m > ai_p
+            ):
+                problems.append(
+                    f"dedup-insert arithmetic intensity did not rise "
+                    f"under --mxu ({ai_p} -> {ai_m})"
+                )
+            elif isinstance(ai_m, (int, float)):
+                out["tpu_2pc7_dedup_intensity"] = {
+                    "unflagged": ai_p, "mxu": ai_m,
+                }
+    out["present"] = present
+    out["ok"] = not problems  # flag-gated: absence is not a failure
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(
+        baseline.get("tpu_paxos3_mxu_roofline")
+        or baseline.get("tpu_paxos3_mxu_unique")
+    )
+    return out
+
+
 def diff_verdict(run: dict, baseline: dict) -> dict:
     """``--diff``: the contract-aware report diff
     (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
@@ -558,7 +705,7 @@ def main(argv=None, fleet=None) -> int:
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
-    roofline = diff = False
+    roofline = diff = mxu = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -581,6 +728,8 @@ def main(argv=None, fleet=None) -> int:
             spill = True
         elif a == "--roofline":
             roofline = True
+        elif a == "--mxu":
+            mxu = True
         elif a == "--diff":
             diff = True
         else:
@@ -644,6 +793,13 @@ def main(argv=None, fleet=None) -> int:
         # stale artifacts and pre-roofline baselines never trip
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["roofline"]["ok"]
+    if mxu:
+        verdict["mxu"] = mxu_verdict(run, baseline)
+        # flag-gated legs: absence passes; a present-but-crashed,
+        # count-drifting, or payoff-missing leg trips fresh runs only
+        # (stale/pre-mxu baselines never trip — the spill rule)
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["mxu"]["ok"]
     if diff:
         verdict["diff"] = diff_verdict(run, baseline)
         # same freshness rule: stale artifacts and pre-registry
@@ -734,6 +890,18 @@ def main(argv=None, fleet=None) -> int:
             "non-XLA-reconciling) roofline block (tpu_paxos3_roofline) — "
             "a perf number without its cost ledger cannot drive the MXU "
             "round (docs/roofline.md)\n"
+        )
+        return 1
+    if (
+        "mxu" in verdict
+        and verdict["fresh"]
+        and not verdict["mxu"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the MXU-recast legs failed their payoff/parity "
+            "bars (tpu_*_mxu_*; see stdout JSON) — a recast that drifts "
+            "counts or moves no fewer bytes did not execute the hot-spot "
+            "list (docs/roofline.md)\n"
         )
         return 1
     if (

@@ -636,10 +636,54 @@ _RECAST = {
 }
 
 
-def mxu_candidates(stages: dict) -> list:
+# landed escape hatches, the JX305 pattern: (stage, op_class) whose
+# recast SHIPPED as an --mxu component.  Pre-flag, the JX400/JX401
+# finding names the hatch; with the component armed, the finding goes
+# SILENT (the recast is live — re-advertising it would be noise), both
+# pinned by test.  The mxu-config attribute names the component that
+# retires the site.
+_LANDED_HATCH = {
+    ("dedup-insert", "gather"): (
+        "probe",
+        "--mxu / CheckerBuilder.mxu() (BLEST one-hot probe; "
+        "docs/roofline.md)",
+    ),
+    ("queue", "gather"): (
+        "slim_queue",
+        "--mxu / CheckerBuilder.mxu() (slim queue traffic; "
+        "docs/roofline.md)",
+    ),
+    ("queue", "scatter"): (
+        "slim_queue",
+        "--mxu / CheckerBuilder.mxu() (slim queue traffic; "
+        "docs/roofline.md)",
+    ),
+    ("expand", "scatter"): (
+        "coalesce",
+        "--mxu / CheckerBuilder.mxu() (expand-scatter coalescing; "
+        "docs/roofline.md)",
+    ),
+}
+
+
+def _landed_hatch(stage: str, op_class: str, mxu=None):
+    """``(armed, hatch_text)`` for a ranked site: ``hatch_text`` is the
+    landed escape hatch (None when no recast shipped for the site),
+    ``armed`` whether the resolving component is ON in ``mxu``."""
+    entry = _LANDED_HATCH.get((stage, op_class))
+    if entry is None:
+        return False, None
+    component, text = entry
+    armed = bool(mxu is not None and getattr(mxu, component, False))
+    return armed, text
+
+
+def mxu_candidates(stages: dict, mxu=None) -> list:
     """Gather/scatter/sort sites whose shapes admit a blocked-matmul
     recast, ranked by charged bytes (the list docs/roofline.md's
-    hot-spot table is generated from)."""
+    hot-spot table is generated from).  Sites whose landed recast
+    component is armed in ``mxu`` carry ``recast_landed: true`` — the
+    findings layer goes silent on them (the JX305 pattern)."""
     out = []
     for sname, stage in stages.items():
         for (prim, shape, op_shape), site in stage.movement.items():
@@ -647,7 +691,8 @@ def mxu_candidates(stages: dict) -> list:
             if total < MXU_MIN_BYTES:
                 continue
             rule, recast = _RECAST[site.op_class]
-            out.append({
+            armed, hatch = _landed_hatch(sname, site.op_class, mxu)
+            entry = {
                 "stage": sname,
                 "op": prim,
                 "op_class": site.op_class,
@@ -658,7 +703,12 @@ def mxu_candidates(stages: dict) -> list:
                 "flops": int(site.flops),
                 "rule": rule,
                 "recast": recast,
-            })
+            }
+            if hatch:
+                entry["escape_hatch"] = hatch
+            if armed:
+                entry["recast_landed"] = True
+            out.append(entry)
     out.sort(key=lambda c: (-c["bytes"], c["stage"], c["op"]))
     for rank, c in enumerate(out, 1):
         c["rank"] = rank
@@ -666,9 +716,14 @@ def mxu_candidates(stages: dict) -> list:
 
 
 def mxu_findings(candidates: list, stages: dict) -> list:
-    """The ranking as ``JX4xx`` informational audit findings."""
+    """The ranking as ``JX4xx`` informational audit findings.  A site
+    whose recast flag is armed (``recast_landed``) emits NO finding —
+    the hatch is taken; pre-flag, the message names it (JX305's
+    actionable-pointer pattern, pinned by test)."""
     findings = []
     for c in candidates:
+        if c.get("recast_landed"):
+            continue
         findings.append(AuditFinding(
             c["rule"], Severity.INFO, f"stage:{c['stage']}",
             f"MXU candidate #{c['rank']}: {c['op']} moving "
@@ -677,7 +732,11 @@ def mxu_findings(candidates: list, stages: dict) -> list:
                 f" over operand {c['operand_shape']}"
                 if c["operand_shape"] else ""
             )
-            + f", x{c['count']}) admits a {c['recast']}",
+            + f", x{c['count']}) admits a {c['recast']}"
+            + (
+                f" — landed escape hatch: {c['escape_hatch']}"
+                if c.get("escape_hatch") else ""
+            ),
         ))
     if candidates:
         by_stage: dict = {}
@@ -779,7 +838,7 @@ def _trace(fn, avals):
 
 
 def _stage_fns(tensor, cap: int, qcap: int, batch: int, cand: int,
-               sym: bool):
+               sym: bool, mxu=None):
     """``name -> (fn, avals)`` for the five wavefront pipeline stages at
     these capacities — the same kernels (and shapes) one engine step
     runs, traced standalone so each stage's costs attribute cleanly.
@@ -792,17 +851,35 @@ def _stage_fns(tensor, cap: int, qcap: int, batch: int, cand: int,
     attribution.  The XLA reconciliation checks each stage against its
     OWN compile, so a drift against the engine would NOT trip it —
     when touching ``_build_engine``'s insert or queue-append wiring,
-    update this mirror with it."""
+    update this mirror with it.
+
+    ``mxu`` (``ops/mxu.MxuConfig``, None = off) mirrors the engine's
+    MXU-recast knobs (docs/roofline.md "Executing the hot-spot list"):
+    ``coalesce`` traces the twin's coalesced expand kernel, ``probe``
+    passes ``probe_dot`` into the insert mirror, and ``slim_queue``
+    swaps the queue mirror's stack-wide append for the engine's
+    ``batch``-chunked loop gated on a traced ``n_new`` — so the ledger
+    charges exactly what the flagged engine program moves."""
     import jax
     import jax.numpy as jnp
 
     from ..ops.buckets import bucket_insert
     from ..ops.hashing import row_hash
+    from ..ops.mxu import coalesced_step_fn
 
     width, arity = tensor.width, tensor.max_actions
     m = batch * arity
     eff_cand = min(cand, m) if cand else m
     qalloc = qcap + m
+    probe_dot = bool(mxu is not None and mxu.probe)
+    # the engine's static slim-queue decision, mirrored (wavefront
+    # _build_engine): chunk width min(batch, eff_cand), plain fallback
+    # when it does not divide the candidate stack
+    qchunk = min(batch, eff_cand)
+    slim_queue = bool(
+        mxu is not None and mxu.slim_queue and eff_cand % qchunk == 0
+    )
+    step_rows_fn = coalesced_step_fn(tensor, mxu)
     sds = jax.ShapeDtypeStruct
     rows = sds((batch, width), jnp.uint64)
     succ = sds((batch, arity, width), jnp.uint64)
@@ -814,11 +891,11 @@ def _stage_fns(tensor, cap: int, qcap: int, batch: int, cand: int,
     def insert_fn(tfp, tpl, cfp, cpar):
         return bucket_insert(
             tfp, tpl, cfp, cpar, window=batch, generation_order=sym,
-            compact=eff_cand,
+            compact=eff_cand, probe_dot=probe_dot,
         )
 
     def queue_fn(qrows, qfp, qebits, qdepth, head, tail, crows, cfp,
-                 cebt, cdep, sel):
+                 cebt, cdep, sel, n_new=None):
         # the engine's per-step queue traffic: pop one batch window,
         # append the novel-compacted candidate window at the tail
         out_rows = jax.lax.dynamic_slice(
@@ -827,21 +904,64 @@ def _stage_fns(tensor, cap: int, qcap: int, batch: int, cand: int,
         out_fp = jax.lax.dynamic_slice(qfp, (head,), (batch,))
         out_eb = jax.lax.dynamic_slice(qebits, (head,), (batch,))
         out_dp = jax.lax.dynamic_slice(qdepth, (head,), (batch,))
-        qrows = jax.lax.dynamic_update_slice(
-            qrows, crows[sel], (tail, jnp.int32(0))
-        )
-        qfp = jax.lax.dynamic_update_slice(qfp, cfp[sel], (tail,))
-        qebits = jax.lax.dynamic_update_slice(qebits, cebt[sel], (tail,))
-        qdepth = jax.lax.dynamic_update_slice(qdepth, cdep[sel], (tail,))
+        if slim_queue:
+            # the engine's append_novel slim path (wavefront.py): one
+            # batch-sized chunk per loop trip, gated on n_new — the
+            # walk charges the body once, so charged bytes track the
+            # chunk window, matching the flagged engine program
+            def chunk(state):
+                k, qr, qf, qe, qd = state
+                off = k * qchunk
+                w_idx = jax.lax.dynamic_slice(sel, (off,), (qchunk,))
+                qr = jax.lax.dynamic_update_slice(
+                    qr, crows[w_idx], (tail + off, jnp.int32(0))
+                )
+                qf = jax.lax.dynamic_update_slice(
+                    qf, cfp[w_idx], (tail + off,)
+                )
+                qe = jax.lax.dynamic_update_slice(
+                    qe, cebt[w_idx], (tail + off,)
+                )
+                qd = jax.lax.dynamic_update_slice(
+                    qd, cdep[w_idx], (tail + off,)
+                )
+                return k + 1, qr, qf, qe, qd
+
+            _, qrows, qfp, qebits, qdepth = jax.lax.while_loop(
+                lambda st: st[0] * qchunk < n_new,
+                chunk,
+                (jnp.int32(0), qrows, qfp, qebits, qdepth),
+            )
+        else:
+            qrows = jax.lax.dynamic_update_slice(
+                qrows, crows[sel], (tail, jnp.int32(0))
+            )
+            qfp = jax.lax.dynamic_update_slice(qfp, cfp[sel], (tail,))
+            qebits = jax.lax.dynamic_update_slice(
+                qebits, cebt[sel], (tail,)
+            )
+            qdepth = jax.lax.dynamic_update_slice(
+                qdepth, cdep[sel], (tail,)
+            )
         return (out_rows, out_fp, out_eb, out_dp, qrows, qfp, qebits,
                 qdepth)
 
     def expand_fn(r):
-        s, valid = tensor.step_rows(r)
+        s, valid = step_rows_fn(r)
         if getattr(tensor, "has_boundary", False):
             valid = valid & tensor.boundary_rows(s)
         return s, valid
 
+    queue_avals = (
+        sds((qalloc, width), jnp.uint64), sds((qalloc,), jnp.uint64),
+        sds((qalloc,), jnp.uint32), sds((qalloc,), jnp.uint32),
+        sds((), jnp.int32), sds((), jnp.int32),
+        sds((m, width), jnp.uint64), sds((m,), jnp.uint64),
+        sds((m,), jnp.uint32), sds((m,), jnp.uint32),
+        sds((m,), jnp.int32),
+    )
+    if slim_queue:
+        queue_avals = queue_avals + (sds((), jnp.int32),)
     return {
         "property": (tensor.property_masks, (rows,)),
         "expand": (expand_fn, (rows,)),
@@ -853,17 +973,7 @@ def _stage_fns(tensor, cap: int, qcap: int, batch: int, cand: int,
                 sds((m,), jnp.uint64), sds((m,), jnp.uint64),
             ),
         ),
-        "queue": (
-            queue_fn,
-            (
-                sds((qalloc, width), jnp.uint64), sds((qalloc,), jnp.uint64),
-                sds((qalloc,), jnp.uint32), sds((qalloc,), jnp.uint32),
-                sds((), jnp.int32), sds((), jnp.int32),
-                sds((m, width), jnp.uint64), sds((m,), jnp.uint64),
-                sds((m,), jnp.uint32), sds((m,), jnp.uint32),
-                sds((m,), jnp.int32),
-            ),
-        ),
+        "queue": (queue_fn, queue_avals),
     }
 
 
@@ -881,12 +991,16 @@ def _cost_cache(tensor) -> Optional[dict]:
 def wavefront_costs(
     tensor, cap: int, qcap: int, batch: int,
     cand: Optional[int] = None, *, sym: bool = False,
-    reconcile: bool = True,
+    reconcile: bool = True, mxu=None,
 ) -> Optional[CostReport]:
     """The wavefront engine's full cost ledger at these capacities
     (cached on the twin — kernels cannot change under a fixed twin).
-    Returns None when the twin has no usable width/arity or a kernel
-    does not trace (the structural audit already reports those)."""
+    ``mxu`` mirrors the engine's MXU-recast knobs into the stage
+    kernels (see ``_stage_fns``), so a flagged run's ledger prices the
+    flagged program — the before/after evidence ``regress.py --mxu``
+    gates on.  Returns None when the twin has no usable width/arity or
+    a kernel does not trace (the structural audit already reports
+    those)."""
     width = getattr(tensor, "width", None)
     arity = getattr(tensor, "max_actions", None)
     if not isinstance(width, int) or not isinstance(arity, int):
@@ -894,6 +1008,8 @@ def wavefront_costs(
     cand = cand or max(4 * batch, 4096)
     key = ("wavefront", cap, qcap, batch, min(cand, batch * arity),
            bool(sym), bool(reconcile))
+    if mxu is not None:
+        key = key + (tuple(mxu),)
     cache = _cost_cache(tensor)
     if cache is not None and key in cache:
         return cache[key]
@@ -903,7 +1019,7 @@ def wavefront_costs(
         # footprint/run_jaxpr_audit discipline — constants materialized
         # inside a make_jaxpr trace would leak tracers into the cache)
         np.asarray(tensor.init_rows())
-        fns = _stage_fns(tensor, cap, qcap, batch, cand, sym)
+        fns = _stage_fns(tensor, cap, qcap, batch, cand, sym, mxu=mxu)
     except Exception:  # noqa: BLE001 - JX000 covers trace failures
         return None
     stages: dict = {}
@@ -930,7 +1046,19 @@ def wavefront_costs(
             actions = action_costs(expand_closed, arity)
         except Exception:  # noqa: BLE001 - attribution only, never fatal
             actions = None
-    candidates = mxu_candidates(stages)
+    # landed-recast bookkeeping prices what actually traced: coalesce
+    # downgrades when the twin has no coalesced kernel (effective_mxu),
+    # slim_queue when the chunk width does not divide the candidate
+    # stack (the _stage_fns/_build_engine static fallback) — a fallen-
+    # back component must never silence its JX400 findings
+    from ..ops.mxu import effective_mxu
+
+    mxu_eff = effective_mxu(tensor, mxu)
+    if mxu_eff is not None and mxu_eff.slim_queue:
+        ec = min(cand, batch * arity)
+        if ec % min(batch, ec):
+            mxu_eff = mxu_eff._replace(slim_queue=False)
+    candidates = mxu_candidates(stages, mxu=mxu_eff)
     out = CostReport(
         engine="wavefront",
         shapes={"batch": batch, "capacity": cap, "queue_capacity": qcap,
@@ -946,7 +1074,7 @@ def wavefront_costs(
 
 def sharded_costs(
     tensor, cap_local: int, fcap_local: int, ndev: int,
-    *, sym: bool = False, reconcile: bool = True,
+    *, sym: bool = False, reconcile: bool = True, mxu=None,
 ) -> Optional[CostReport]:
     """The sharded engine's MODEL-kernel ledger (property/expand/hash at
     the per-device frontier width).  The engine-side insert and
@@ -959,6 +1087,8 @@ def sharded_costs(
         return None
     key = ("sharded", cap_local, fcap_local, ndev, bool(sym),
            bool(reconcile))
+    if mxu is not None:
+        key = key + (tuple(mxu),)
     cache = _cost_cache(tensor)
     if cache is not None and key in cache:
         return cache[key]
@@ -966,7 +1096,7 @@ def sharded_costs(
         np.asarray(tensor.init_rows())
         fns = _stage_fns(
             tensor, cap_local, max(cap_local // 2, 1), fcap_local,
-            4 * fcap_local, sym,
+            4 * fcap_local, sym, mxu=mxu,
         )
     except Exception:  # noqa: BLE001
         return None
@@ -994,7 +1124,9 @@ def sharded_costs(
             actions = action_costs(expand_closed, arity)
         except Exception:  # noqa: BLE001
             actions = None
-    candidates = mxu_candidates(stages)
+    from ..ops.mxu import effective_mxu
+
+    candidates = mxu_candidates(stages, mxu=effective_mxu(tensor, mxu))
     out = CostReport(
         engine="sharded",
         shapes={"batch": fcap_local, "capacity": cap_local * ndev,

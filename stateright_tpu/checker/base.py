@@ -48,6 +48,9 @@ class CheckerBuilder:
         self.por_mode: Optional[bool] = None
         # billion-state spill tier (docs/spill.md); None = env default
         self.spill_mode: Optional[bool] = None
+        # MXU recast round (ops/mxu.py, docs/roofline.md); None = env
+        # default (STATERIGHT_TPU_MXU, off when unset)
+        self.mxu_opts: Optional[dict] = None
         # periodic crash-safe autosave (stateright_tpu/checkpoint.py,
         # docs/robustness.md); None = env default (STATERIGHT_TPU_AUTOSAVE)
         self.autosave_opts: Optional[dict] = None
@@ -343,6 +346,59 @@ class CheckerBuilder:
         ``STATERIGHT_TPU_POR=1``.  Composes with ``symmetry()`` and
         ``prededup()``."""
         self.por_mode = bool(enabled)
+        return self
+
+    def mxu(
+        self,
+        enabled: bool = True,
+        *,
+        coalesce: bool = True,
+        slim_queue: bool = True,
+        probe: bool = True,
+    ) -> "CheckerBuilder":
+        """Arm the MXU recast round on the device engines
+        (``stateright_tpu/ops/mxu.py``; docs/roofline.md "Executing the
+        hot-spot list"): three flag-gated bytes-moved reductions
+        executing PR 11's ranked JX4xx hot spots —
+
+        - ``coalesce``: trace the twin's expand-scatter-coalesced step
+          kernel (``step_rows_coalesced``; hand twins + per-channel
+          compiled twins) — each action piece's packed-field write-backs
+          assemble as one word-stacked block instead of one scatter per
+          field (the paxos-3 #1 hot spot: 37 sites, 109 MB/step).
+          Twins without a coalesced form silently keep the plain kernel;
+        - ``slim_queue``: append novel queue rows in ``batch``-sized
+          chunks gated on the novel count instead of one
+          candidate-stack-wide ``dynamic_update_slice`` window (queue
+          rows 1-3 of the ledger);
+        - ``probe``: the BLEST one-hot membership probe — the bucket
+          membership/occupancy reductions become one blocked bitmapped
+          ``dot_general`` over the candidate x slot comparison tile,
+          giving the dedup-insert stage a genuine dot-class op (the
+          2pc-7 #1 hot spot).
+
+        Contract, pinned by tests (the prededup/spill discipline): OFF
+        (the default) leaves the step jaxpr bit-identical and the engine
+        cache unkeyed; ON keeps unique/total counts, property verdicts,
+        and discovery traces bit-identical across the fleet — the
+        transforms move the same information through cheaper shapes.
+        The roofline ledger (``.roofline()``) measures the payoff;
+        ``regress.py --mxu`` gates it.  Env override
+        ``STATERIGHT_TPU_MXU=1`` (all three components); composes with
+        ``symmetry()``/``por()``/``prededup()``/``spill()``."""
+        if not enabled:
+            # explicit off wins over the env knob (resolve_flag's rule):
+            # an all-off component dict resolves to None without ever
+            # consulting STATERIGHT_TPU_MXU
+            self.mxu_opts = {
+                "coalesce": False, "slim_queue": False, "probe": False,
+            }
+            return self
+        self.mxu_opts = {
+            "coalesce": bool(coalesce),
+            "slim_queue": bool(slim_queue),
+            "probe": bool(probe),
+        }
         return self
 
     def spill(self, enabled: bool = True) -> "CheckerBuilder":

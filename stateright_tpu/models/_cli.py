@@ -88,9 +88,10 @@ def run_cli(
         print(usage)
         if check_tpu is not None:
             print("  device verbs also take --checked, --prewarm, "
-                  "--prededup, --por, --per-channel, --spill, "
+                  "--prededup, --por, --per-channel, --spill, --mxu, "
                   "--compile-cache=DIR "
-                  "(docs/perf.md, docs/analysis.md, docs/spill.md) and "
+                  "(docs/perf.md, docs/analysis.md, docs/spill.md, "
+                  "docs/roofline.md) and "
                   "--watch (live status line, docs/telemetry.md)")
         if audit is not None:
             print("  <example> audit    # static preflight audit "
@@ -111,9 +112,10 @@ def run_cli(
             print("  <example> capacity [ARGS]  # HBM capacity plan: "
                   "analytic footprint per growth rung (docs/telemetry.md)")
         if costmodel is not None:
-            print("  <example> costmodel [--out=F] [ARGS]  # roofline "
-                  "cost ledger: per-stage FLOPs/bytes, XLA "
-                  "reconciliation, MXU candidates (docs/roofline.md)")
+            print("  <example> costmodel [--out=F] [--mxu] [ARGS]  "
+                  "# roofline cost ledger: per-stage FLOPs/bytes, XLA "
+                  "reconciliation, MXU candidates; --mxu prices the "
+                  "recast program (docs/roofline.md)")
         if compare is not None:
             print("  <example> compare A B [--registry=DIR] "
                   "[--expect=VERDICT]  # contract-aware run diff: "
@@ -147,13 +149,16 @@ def pop_perf(rest: list) -> tuple:
     work without the flags — these exist so one-off CLI runs can A/B."""
     rest = list(rest)
     cfg = {"prewarm": False, "prededup": False, "compile_cache": None,
-           "por": False, "spill": False, "per_channel": False}
+           "por": False, "spill": False, "per_channel": False,
+           "mxu": False}
     kept = []
     for a in rest:
         if a == "--prewarm":
             cfg["prewarm"] = True
         elif a == "--prededup":
             cfg["prededup"] = True
+        elif a == "--mxu":
+            cfg["mxu"] = True
         elif a == "--por":
             cfg["por"] = True
         elif a == "--spill":
@@ -180,6 +185,8 @@ def apply_perf(builder, cfg: dict):
         builder = builder.por()
     if cfg.get("spill"):
         builder = builder.spill()
+    if cfg.get("mxu"):
+        builder = builder.mxu()
     if cfg.get("compile_cache"):
         builder = builder.compile_cache(cfg["compile_cache"])
     return builder
@@ -776,7 +783,7 @@ _COSTMODEL_CAP = 1 << 14
 
 
 def costmodel_and_report(
-    models: Iterable[tuple], stream=None, out=None,
+    models: Iterable[tuple], stream=None, out=None, mxu: bool = False,
 ) -> bool:
     """Roofline cost ledger over ``(label, model)`` pairs
     (``analysis/costmodel.py`` + ``telemetry/roofline.py``;
@@ -785,13 +792,18 @@ def costmodel_and_report(
     device spec is known (``STATERIGHT_TPU_DEVICE_SPEC``), the
     XLA-reconciliation verdict, and the JX4xx MXU-candidate findings.
     ``out`` collects the per-config live blocks into a JSON file (the
-    schema round-trip fixture / CI artifact).  Returns True iff every
+    schema round-trip fixture / CI artifact).  ``mxu`` prices the
+    ``--mxu``-flagged engine program instead (docs/roofline.md
+    "Executing the hot-spot list"): the coalesced expand kernel, the
+    slim queue mirror, and the BLEST probe — landed-recast findings go
+    silent (the JX305 pattern).  Returns True iff every
     twin-bearing configuration produced a well-formed, XLA-reconciling
     ledger (twin-less models are disclosed and skipped — host checkers
     have no device pipeline to price)."""
     import json
 
     from ..analysis.costmodel import wavefront_costs
+    from ..ops.mxu import MxuConfig
     from ..parallel.tensor_model import twin_or_none
     from ..telemetry.memory import fmt_bytes
     from ..telemetry.roofline import classify_stages, device_spec
@@ -814,6 +826,7 @@ def costmodel_and_report(
             rep = wavefront_costs(
                 twin, _COSTMODEL_CAP, _COSTMODEL_CAP // 2,
                 _COSTMODEL_BATCH,
+                mxu=MxuConfig() if mxu else None,
             )
         except Exception as e:  # noqa: BLE001 - a ledger crash is a
             # verdict, not a crash (the capacity-verb contract)
@@ -876,7 +889,11 @@ def make_costmodel_cmd(factory: Callable[[list], Iterable[tuple]]) -> Callable:
 
     def _costmodel(rest: list) -> None:
         out, _chrome, rest = _split_profile_args(rest, default_out="")
-        if not costmodel_and_report(factory(rest), out=out or None):
+        mxu = "--mxu" in rest
+        rest = [a for a in rest if a != "--mxu"]
+        if not costmodel_and_report(
+            factory(rest), out=out or None, mxu=mxu
+        ):
             print("costmodel: FAILED")
             raise SystemExit(1)
 

@@ -57,7 +57,7 @@ from ..parallel.actor_tensor import (
     slot_canonicalize,
     slot_send,
 )
-from ..parallel.tensor_model import BitPacker, TensorModel
+from ..parallel.tensor_model import BitPacker, FieldWriter, TensorModel
 from ..semantics.linearizability import LinearizabilityTester
 from ..semantics.register import READ, Register, write
 
@@ -398,6 +398,20 @@ class PaxosTensor(TensorModel):
     # ------------------------------------------------------------------
 
     def step_rows(self, rows):
+        return self._step_rows_impl(rows, coalesce=False)
+
+    def step_rows_coalesced(self, rows):
+        """Expand-scatter-coalesced step (``ops/mxu.py``, docs/roofline.md):
+        the same transition function with the packed-word write-backs
+        assembled as ONE word-stacked block (``FieldWriter`` coalesced
+        mode) instead of 37 per-field scatters — the JX400 #1 expand hot
+        spot on paxos-3.  Successors and validity are bit-identical to
+        :meth:`step_rows` (whole-space parity pinned in tests); only the
+        assembly shape changes.  Selected by the engines under
+        ``CheckerBuilder.mxu()`` / ``--mxu``."""
+        return self._step_rows_impl(rows, coalesce=True)
+
+    def _step_rows_impl(self, rows, coalesce):
         import jax.numpy as jnp
 
         C, NS, pk = self.C, self.n_slots, self.pk
@@ -615,14 +629,23 @@ class PaxosTensor(TensorModel):
         slots_d = slot_canonicalize(slots_d)
 
         # -- assemble successor packed words --------------------------------
-        out = jnp.broadcast_to(rows[:, None, :], (B, A, W))
+        # eager: the pre-writer broadcast + per-field pk.set trace,
+        # bit-identical (pinned).  Coalesced: the base block covers only
+        # the packed words and the writer assembles them as one
+        # word-stacked concatenate (FieldWriter; ops/mxu.py).
+        if coalesce:
+            base = jnp.broadcast_to(
+                rows[:, None, : self.pw], (B, A, self.pw)
+            )
+        else:
+            base = jnp.broadcast_to(rows[:, None, :], (B, A, W))
+        fw = FieldWriter(pk, base, coalesce=coalesce)
 
         def scatter_server(name, new_val, old_stacked):
-            nonlocal out
             for s in range(S):
                 old = old_stacked[:, s : s + 1]
                 v = jnp.where(valid & is_server & (dst == s), new_val, old)
-                out = pk.set(out, f"s{s}_{name}", v.astype(u64))
+                fw.set(f"s{s}_{name}", v.astype(u64))
 
         scatter_server("rnd", new_rnd, srv["rnd"])
         scatter_server("ldr", new_ldr, srv["ldr"])
@@ -635,34 +658,36 @@ class PaxosTensor(TensorModel):
 
         for c in range(C):
             m = valid & is_client & (dst == S + c)
-            out = pk.set(
-                out,
+            fw.set(
                 f"c{c}_phase",
                 jnp.where(m, new_phase, cph[:, c : c + 1]).astype(u64),
             )
-            out = pk.set(
-                out,
+            fw.set(
                 f"c{c}_rval",
                 jnp.where(
                     m & k_cgetok, new_rval, gi(f"c{c}_rval")
                 ).astype(u64),
             )
-            out = pk.set(
-                out,
+            fw.set(
                 f"c{c}_snap",
                 jnp.where(
                     m & k_cputok, snap_val, gi(f"c{c}_snap")
                 ).astype(u64),
             )
-        out = pk.set(
-            out,
+        fw.set(
             "overflow",
             jnp.maximum(
                 jnp.where(of, 1, 0), gi("overflow")
             ).astype(u64),
         )
+        out = fw.done()
 
-        succ = jnp.concatenate([out[:, :, : self.pw], slots_d], axis=-1)
+        if coalesce:
+            succ = jnp.concatenate([out, slots_d], axis=-1)
+        else:
+            succ = jnp.concatenate(
+                [out[:, :, : self.pw], slots_d], axis=-1
+            )
         return succ, valid
 
     def property_masks(self, rows):

@@ -146,6 +146,12 @@ def bucket_insert(
     #                            (needed for symmetry runs; see below)
     compact: int = None,  # optional valid-candidate budget CB: compact valid
     #                       lanes first and run the pipeline at width CB
+    probe_dot: bool = False,  # BLEST one-hot membership probe (ops/mxu.py):
+    #                           the membership/occupancy reductions over the
+    #                           gathered bucket lines become ONE blocked
+    #                           bitmapped dot_general — bit-identical
+    #                           (present, base) per window, pinned by test.
+    #                           Off adds zero ops (the prededup contract).
 ):
     """Insert all valid candidates; returns ``(table_fp, table_payload,
     sel, n_new, overflow, cand_overflow)``.
@@ -226,10 +232,19 @@ def bucket_insert(
         wbkt = jax.lax.dynamic_slice(pbucket, (off,), (window,))
         wfp = jax.lax.dynamic_slice(psfp, (off,), (window,))
         lines = table_lines[wbkt]
-        p = jnp.any(lines == wfp[:, None], axis=-1)
-        # occupancy comes free from the same gathered line: slots fill
-        # densely from 0 and never free, so non-EMPTY count == next slot
-        b = jnp.sum(lines != EMPTY, axis=-1).astype(jnp.int32)
+        if probe_dot:
+            # BLEST one-hot probe (ops/mxu.py): one blocked bitmapped
+            # matmul over the candidate x slot comparison tile replaces
+            # the reduce_or/reduce_sum pair — same (present, base) bits,
+            # but a genuine dot-class op for the MXU to chew on-chip
+            from .mxu import blest_probe
+
+            p, b = blest_probe(lines, wfp, EMPTY)
+        else:
+            p = jnp.any(lines == wfp[:, None], axis=-1)
+            # occupancy comes free from the same gathered line: slots fill
+            # densely from 0 and never free, so non-EMPTY count == next slot
+            b = jnp.sum(lines != EMPTY, axis=-1).astype(jnp.int32)
         present = jax.lax.dynamic_update_slice(present, p, (off,))
         base = jax.lax.dynamic_update_slice(base, b, (off,))
         return k + 1, present, base

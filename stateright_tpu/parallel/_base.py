@@ -167,6 +167,18 @@ class WavefrontChecker(Checker):
                     "of .spill()/.por()."
                 )
             self._init_spill()
+        # MXU recast round (ops/mxu.py, docs/roofline.md): the three
+        # bytes-moved reductions executing the JX4xx hot-spot ranking.
+        # Resolved ONCE here for both engines; None (off) keeps the step
+        # jaxpr bit-identical and the engine cache unkeyed (pinned).
+        # The POR plan above deliberately footprints the PLAIN step
+        # kernel either way: the coalesced kernel computes the same
+        # transition function, so one conflict matrix serves both and
+        # the ample sets — hence the explored set — cannot drift with
+        # the flag.
+        from ..ops.mxu import resolve_mxu
+
+        self._mxu = resolve_mxu(getattr(options, "mxu_opts", None))
         self._prewarm = resolve_flag(
             getattr(options, "prewarm_mode", None), ENV_PREWARM
         )

@@ -82,7 +82,7 @@ from .history_tensor import (
     LinHistoryCodec,
     MultiOpLinHistoryCodec,
 )
-from .tensor_model import BitPacker, TensorModel
+from .tensor_model import BitPacker, FieldWriter, TensorModel
 
 #: envelope-kind codes for the history/property tables
 _K_OTHER, _K_PUT_OK, _K_GET_OK, _K_PUT_FAIL = 0, 1, 2, 3
@@ -1365,6 +1365,30 @@ class CompiledActorTensor(TensorModel):
             return self._step_rows_per_channel(rows)
         return self._step_rows_multiset(rows)
 
+    @property
+    def has_coalesced_step(self) -> bool:
+        """Only the per-channel kernel has a coalesced form —
+        ``ops/mxu.has_coalesced_step`` consults this so the engines and
+        the ledger's landed-recast bookkeeping both see the multiset
+        fallback (a fallen-back coalesce must never silence its JX400
+        findings)."""
+        return bool(self.per_channel)
+
+    def step_rows_coalesced(self, rows):
+        """Expand-scatter-coalesced step (``ops/mxu.py``,
+        docs/roofline.md): the per-channel kernel with each action
+        piece's packed-field write-backs assembled as ONE word-stacked
+        block (``FieldWriter`` coalesced mode) instead of one scatter
+        per field.  Successors/validity bit-identical to
+        :meth:`step_rows` (whole-space parity pinned in tests).
+        Slot-multiset twins have no coalesced form — their packed
+        writes are already few and the encoding is the JX302/JX305
+        story — so they fall back to the plain kernel (and advertise it
+        via :attr:`has_coalesced_step`)."""
+        if self.per_channel:
+            return self._step_rows_per_channel(rows, coalesce=True)
+        return self._step_rows_multiset(rows)
+
     def _step_rows_multiset(self, rows):
         import jax.numpy as jnp
 
@@ -1709,49 +1733,36 @@ class CompiledActorTensor(TensorModel):
         base = self.pw + self._ch_base[ci]
         return rows[..., base : base + self._ch_cap[ci]]
 
-    def _or_field(self, out, name: str, flag):
-        """OR ``flag`` (bool[...]) into the 1-bit packed field ``name``
-        WITHOUT reading it back through ``pk.get``: the lane stays an
-        identity of its own word with one OR-accumulated bit, which the
-        footprint pass classifies as an accumulator write (monotone, so
-        two actions' poison writes commute; ``docs/analysis.md``)."""
-        import jax.numpy as jnp
-
-        word, off, _bits = self.pk.layout[name]
-        v = flag.astype(jnp.uint64)
-        if off:
-            v = v << jnp.uint64(off)
-        return out.at[..., word].set(out[..., word] | v)
-
-    def _channel_history(self, outp, valid, ecode, c, cst, B, cap):
+    def _channel_history(self, fw, valid, ecode, c, cst, B, cap):
         """Register-workload history update for ONE client channel (the
         per-channel twin's analogue of the all-clients history loop in
         the multiset kernel): ``c`` is the client index of the channel's
-        static destination; masks are [B, cap] over the channel's slots."""
+        static destination; masks are [B, cap] over the channel's slots.
+        ``fw`` is the piece's :class:`FieldWriter` — eager mode traces
+        the exact pre-writer ``pk.get``/``pk.set`` sequence (pinned)."""
         import jax.numpy as jnp
 
         i32, u64 = jnp.int32, jnp.uint64
-        pk = self.pk
         kind = cst["env_kind"][ecode]  # [B, cap]
         rv = cst["env_val"][ecode]
         phases = jnp.stack(
             [
-                pk.get(outp, f"h{j}_phase").astype(i32)[:, 0]
+                fw.get(f"h{j}_phase").astype(i32)[:, 0]
                 for j in range(self.C)
             ],
             -1,
-        )  # [B, C] (outp rows are pre-update copies of the input fields)
+        )  # [B, C] (the block rows are pre-update copies of the inputs)
         if self._multi:
             K = self.hist.K
             eb = self.hist.snap_entry_bits
             m_w = valid & (kind == _K_PUT_OK)
             m_r = valid & (kind == _K_GET_OK)
             comp = phases >> 1
-            cur_ph = pk.get(outp, f"h{c}_phase").astype(i32)
+            cur_ph = fw.get(f"h{c}_phase").astype(i32)
             new_ph = jnp.where(
                 m_w, cur_ph + 2, jnp.where(m_r, cur_ph + 1, cur_ph)
             )
-            outp = pk.set(outp, f"h{c}_phase", new_ph.astype(u64))
+            fw.set(f"h{c}_phase", new_ph.astype(u64))
             cur_comp = cur_ph >> 1
             snap = jnp.zeros((B, cap), i32)
             for j in range(self.C):
@@ -1761,16 +1772,14 @@ class CompiledActorTensor(TensorModel):
                 snap = snap | (comp[:, j : j + 1] << (eb * slot))
             for m in range(K):
                 sel = m_w & (cur_comp == m)
-                cur_snap = pk.get(outp, f"h{c}_snap{m}").astype(i32)
-                outp = pk.set(
-                    outp,
+                cur_snap = fw.get(f"h{c}_snap{m}").astype(i32)
+                fw.set(
                     f"h{c}_snap{m}",
                     jnp.where(sel, snap, cur_snap).astype(u64),
                 )
-            cur_rv = pk.get(outp, f"h{c}_rval").astype(i32)
-            return pk.set(
-                outp, f"h{c}_rval", jnp.where(m_r, rv, cur_rv).astype(u64)
-            )
+            cur_rv = fw.get(f"h{c}_rval").astype(i32)
+            fw.set(f"h{c}_rval", jnp.where(m_r, rv, cur_rv).astype(u64))
+            return fw
         m_w = valid & ((kind == _K_PUT_OK) | (kind == _K_PUT_FAIL))
         m_r = valid & (kind == _K_GET_OK)
         comp = jnp.where(
@@ -1778,11 +1787,11 @@ class CompiledActorTensor(TensorModel):
             0,
             jnp.where(phases == PHASE_DONE, 2, 1),
         )
-        cur_ph = pk.get(outp, f"h{c}_phase").astype(i32)
+        cur_ph = fw.get(f"h{c}_phase").astype(i32)
         new_ph = jnp.where(
             m_w, PHASE_R_INFLIGHT, jnp.where(m_r, PHASE_DONE, cur_ph)
         )
-        outp = pk.set(outp, f"h{c}_phase", new_ph.astype(u64))
+        fw.set(f"h{c}_phase", new_ph.astype(u64))
         if self.C > 1:
             snap = jnp.zeros((B, cap), i32)
             for j in range(self.C):
@@ -1790,25 +1799,21 @@ class CompiledActorTensor(TensorModel):
                     continue
                 slot = self.hist._snap_slot(c, j)
                 snap = snap | (comp[:, j : j + 1] << (2 * slot))
-            cur_snap = pk.get(outp, f"h{c}_snap").astype(i32)
-            outp = pk.set(
-                outp,
+            cur_snap = fw.get(f"h{c}_snap").astype(i32)
+            fw.set(
                 f"h{c}_snap",
                 jnp.where(m_w, snap, cur_snap).astype(u64),
             )
-        cur_rv = pk.get(outp, f"h{c}_rval").astype(i32)
-        outp = pk.set(
-            outp, f"h{c}_rval", jnp.where(m_r, rv, cur_rv).astype(u64)
-        )
+        cur_rv = fw.get(f"h{c}_rval").astype(i32)
+        fw.set(f"h{c}_rval", jnp.where(m_r, rv, cur_rv).astype(u64))
         if self.hist.wfail_bits:
             m_wf = m_w & (kind == _K_PUT_FAIL)
-            cur_wf = pk.get(outp, f"h{c}_wfail").astype(i32)
-            outp = pk.set(
-                outp,
+            cur_wf = fw.get(f"h{c}_wfail").astype(i32)
+            fw.set(
                 f"h{c}_wfail",
                 jnp.where(m_wf, 1, cur_wf).astype(u64),
             )
-        return outp
+        return fw
 
     def _assemble_piece(self, outp, rows, lead, work):
         """One action family's row piece ``[B, lead, W]``: the updated
@@ -1870,7 +1875,7 @@ class CompiledActorTensor(TensorModel):
                     overflow = of if overflow is None else (overflow | of)
         return overflow
 
-    def _step_rows_per_channel(self, rows):
+    def _step_rows_per_channel(self, rows, coalesce=False):
         """The per-channel twin's step: the successor stack is assembled
         as one action-axis ``concatenate`` of per-channel pieces whose
         writes are statically confined — its own region (consume), the
@@ -1951,13 +1956,12 @@ class CompiledActorTensor(TensorModel):
             if of is not None:
                 poison = of if poison is None else (poison | of)
 
-            outp = packed_broadcast(cap)
-            outp = pk.set(
-                outp, f"a{d}", jnp.where(valid, nc, sc).astype(u64)
-            )
+            fw = FieldWriter(pk, packed_broadcast(cap),
+                             coalesce=coalesce)
+            fw.set(f"a{d}", jnp.where(valid, nc, sc).astype(u64))
             if self._ch_ret_kind[ci] and self.C:
-                outp = self._channel_history(
-                    outp, valid, ecode, int(self._client_of[d]), cst, B,
+                self._channel_history(
+                    fw, valid, ecode, int(self._client_of[d]), cst, B,
                     cap,
                 )
             if self._has_timers and self._ch_timer[ci]:
@@ -1970,10 +1974,10 @@ class CompiledActorTensor(TensorModel):
                     jnp.where(valid & (eff == 0), 0, bit),
                 )
                 tnew = (tcur & ~(1 << d)) | (nb << d)
-                outp = pk.set(outp, "timers", tnew.astype(u64))
+                fw.set("timers", tnew.astype(u64))
             if poison is not None:
-                outp = self._or_field(outp, "poison", poison)
-            pieces.append(self._assemble_piece(outp, rows, cap, work))
+                fw.or_field("poison", poison)
+            pieces.append(self._assemble_piece(fw.done(), rows, cap, work))
             valids.append(valid)
 
         # -- drop actions (lossy): every channel, network-only effect -------
@@ -2008,16 +2012,16 @@ class CompiledActorTensor(TensorModel):
                 nc = cst["ttrans"][i][sc]
                 nb = cst["tbit"][i][sc]
                 valid_i = (((tcur_all >> i) & 1) == 1)[:, None]  # [B, 1]
-                outp = packed_broadcast(1)
-                outp = pk.set(
-                    outp,
+                fw = FieldWriter(pk, packed_broadcast(1),
+                                 coalesce=coalesce)
+                fw.set(
                     f"a{i}",
                     jnp.where(valid_i, nc[:, None], sc[:, None]).astype(
                         u64
                     ),
                 )
                 tnew = (tcur_all[:, None] & ~(1 << i)) | (nb[:, None] << i)
-                outp = pk.set(outp, "timers", tnew.astype(u64))
+                fw.set("timers", tnew.astype(u64))
                 work: dict = {}
                 ks = cst["tsends"][i][sc][:, None, :]  # [B, 1, Kt]
                 of = self._apply_sends(
@@ -2029,8 +2033,8 @@ class CompiledActorTensor(TensorModel):
                 if of is not None:
                     poison = of if poison is None else (poison | of)
                 if poison is not None:
-                    outp = self._or_field(outp, "poison", poison)
-                pieces.append(self._assemble_piece(outp, rows, 1, work))
+                    fw.or_field("poison", poison)
+                pieces.append(self._assemble_piece(fw.done(), rows, 1, work))
                 valids.append(valid_i)
 
         if not pieces:  # message-less, timer-less: one never-valid column

@@ -106,6 +106,7 @@ def _build_sharded_run(
     prededup: bool = False,
     cartography: bool = False,
     por=None,
+    mxu=None,
 ):
     """Build the jitted whole-run shard_map for fixed per-device capacities.
 
@@ -146,6 +147,14 @@ def _build_sharded_run(
     ndev = mesh.shape[AXIS]
     width, arity = tensor.width, tensor.max_actions
     n_props = len(props)
+    # MXU-recast knobs (ops/mxu.py): the coalesced expand kernel + the
+    # BLEST probe apply here too; slim_queue has no sharded analogue —
+    # the frontier is whole-wavefront compacted, not a FIFO window.
+    # Off keeps the program bit-identical (the prededup contract).
+    from ..ops.mxu import coalesced_step_fn
+
+    step_rows_fn = coalesced_step_fn(tensor, mxu)
+    probe_dot = bool(mxu is not None and mxu.probe)
     ev_idx = [i for i, p in enumerate(props) if p.expectation is Expectation.EVENTUALLY]
     ebit_of = {i: e for e, i in enumerate(ev_idx)}
     if len(ev_idx) > 32:
@@ -282,7 +291,7 @@ def _build_sharded_run(
         tfp, tpl, sel, n_new, toverflow, coverflow = bucket_insert(
             tfp, tpl, cand_fp, cand_par,
             window=min(m, max(64, fcap_local)), generation_order=sym,
-            compact=compact,
+            compact=compact, probe_dot=probe_dot,
         )
         novel = None
         if want_novel:
@@ -378,7 +387,7 @@ def _build_sharded_run(
             # uniform across devices.
             elive = live & ~all_discovered(disc)
 
-            succ, valid = tensor.step_rows(rows)  # [F, A, W], [F, A]
+            succ, valid = step_rows_fn(rows)  # [F, A, W], [F, A]
             if boundary_fn is not None:
                 # host-checker parity: boundary filter before counting
                 valid = valid & boundary_fn(succ)
@@ -769,10 +778,11 @@ class ShardedTpuChecker(WavefrontChecker):
         tensor = self.tensor
         cap_local, fcap_local = self._cap_local, self._fcap_local
         ndev, sym = self.ndev, self._symmetry is not None
+        mxu = self._mxu
 
         def cost_fn():
             return sharded_costs(
-                tensor, cap_local, fcap_local, ndev, sym=sym,
+                tensor, cap_local, fcap_local, ndev, sym=sym, mxu=mxu,
             )
 
         return cost_fn
@@ -1170,6 +1180,19 @@ class ShardedTpuChecker(WavefrontChecker):
             key = (mesh_key, cap, fcap, bucket_cap, cand_local, self._target,
                    sym, self._steps, self._prededup, self._cartography,
                    self._por)
+            if self._mxu is not None:
+                # MXU off leaves the key exactly the pre-MXU tuple (the
+                # wavefront engine's cache-unkeyed discipline), and the
+                # key carries only the EFFECTIVE components the sharded
+                # program actually reads — slim_queue has no sharded
+                # analogue and coalesce falls back on twins without a
+                # coalesced kernel, so keying on either would recompile
+                # an identical shard_map
+                from ..ops.mxu import effective_mxu
+
+                eff = effective_mxu(self.tensor, self._mxu)
+                if eff is not None and (eff.coalesce or eff.probe):
+                    key = key + (("mxu", eff.coalesce, eff.probe),)
             fns = cache.get(key)
             if rec is not None and key != getattr(
                 self, "_last_engine_key", None
@@ -1198,6 +1221,7 @@ class ShardedTpuChecker(WavefrontChecker):
                     cand_local=cand_local, prededup=self._prededup,
                     cartography=self._cartography,
                     por=self._por_plan if self._por else None,
+                    mxu=self._mxu,
                 )
                 cache[key] = fns
             init_fn, step_fn = fns

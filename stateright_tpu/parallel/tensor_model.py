@@ -233,6 +233,132 @@ class RowDomain:
         return out
 
 
+class FieldWriter:
+    """Packed-field write accumulator over a :class:`BitPacker` block —
+    the expand-scatter coalescing seam (``ops/mxu.py``, docs/roofline.md).
+
+    The step kernels build successor packed words by applying one
+    ``pk.set`` per written field, and each traces to a full-block slice
+    read + a one-word scatter: the paxos-3 roofline ledger charged the
+    37 such sites at 109 MB/step, the #1 ranked expand hot spot (JX400).
+    This writer gives the kernels one seam with two materializations:
+
+    - **eager** (``coalesce=False``, the default): every ``set``/
+      ``or_field`` applies through ``pk.set`` / the OR-scatter at call
+      time — op-for-op the pre-writer trace, so refactored kernels keep
+      their step jaxpr bit-identical (pinned by test);
+    - **coalesced** (``coalesce=True``): writes accumulate per word and
+      :meth:`done` assembles the output block with ONE concatenate of
+      per-word columns — modified words rebuilt elementwise from the
+      base word column, untouched words passed through — so the
+      per-field scatters (and their full-block slice reads) vanish from
+      the traced program.
+
+    Field semantics are identical either way (same masks, same
+    precedence: writes apply in call order), which is what makes the
+    engine-level counts bit-identical under the flag — pinned by the
+    whole-space successor-parity tests.
+    """
+
+    def __init__(self, pk: "BitPacker", base, coalesce: bool = False):
+        self.pk = pk
+        self.base = base
+        self.coalesce = bool(coalesce)
+        self.cur = base  # eager running block
+        # coalesced bookkeeping: word -> ordered op list, name -> value
+        self._word_ops: dict[int, list] = {}
+        self._pending: dict[str, object] = {}
+        # name -> field-level OR flags, so get() after or_field matches
+        # eager mode (which reads the running block) bit-for-bit
+        self._or_pending: dict[str, list] = {}
+
+    def set(self, name: str, value) -> "FieldWriter":
+        """Write field ``name`` (uint64[...] matching the block's leading
+        shape)."""
+        if not self.coalesce:
+            self.cur = self.pk.set(self.cur, name, value)
+            return self
+        word, off, bits = self.pk.layout[name]
+        self._word_ops.setdefault(word, []).append(("set", off, bits, value))
+        self._pending[name] = value
+        # a set supersedes earlier ORs into the same field (done()
+        # already applies ops in call order; get() must agree)
+        self._or_pending.pop(name, None)
+        return self
+
+    def get(self, name: str):
+        """Current value of field ``name``: the pending write when one
+        exists, else the base block's field (eager mode reads the running
+        block, exactly as the pre-writer kernels did)."""
+        import jax.numpy as jnp
+
+        if not self.coalesce:
+            return self.pk.get(self.cur, name)
+        v = self._pending.get(name)
+        if v is None:
+            v = self.pk.get(self.base, name)
+        else:
+            _w, _off, bits = self.pk.layout[name]
+            v = (
+                v.astype(jnp.uint64)
+                if hasattr(v, "astype")
+                else jnp.uint64(v)
+            )
+            if bits < 64:
+                v = v & jnp.uint64((1 << bits) - 1)
+        for flag in self._or_pending.get(name, ()):
+            v = v | flag
+        return v
+
+    def or_field(self, name: str, flag) -> "FieldWriter":
+        """OR ``flag`` (bool[...]) into the 1-bit packed field ``name``
+        WITHOUT reading it back through ``pk.get``: the lane stays an
+        identity of its own word with one OR-accumulated bit, which the
+        footprint pass classifies as an accumulator write (monotone, so
+        two actions' poison writes commute; docs/analysis.md)."""
+        import jax.numpy as jnp
+
+        word, off, _bits = self.pk.layout[name]
+        v = flag.astype(jnp.uint64)
+        if off:
+            v = v << jnp.uint64(off)
+        if not self.coalesce:
+            self.cur = self.cur.at[..., word].set(self.cur[..., word] | v)
+            return self
+        self._word_ops.setdefault(word, []).append(("or", v))
+        self._or_pending.setdefault(name, []).append(
+            flag.astype(jnp.uint64)
+        )
+        return self
+
+    def done(self):
+        """Materialize the written block.  Eager: the running block.
+        Coalesced: one concatenate of per-word columns."""
+        if not self.coalesce:
+            return self.cur
+        import jax.numpy as jnp
+
+        cols = []
+        for w in range(self.pk.width):
+            col = self.base[..., w]
+            for op in self._word_ops.get(w, ()):
+                if op[0] == "set":
+                    _, off, bits, v = op
+                    mask = jnp.uint64(((1 << bits) - 1) << off)
+                    v = (
+                        v.astype(jnp.uint64)
+                        if hasattr(v, "astype")
+                        else jnp.uint64(v)
+                    )
+                    if off:
+                        v = v << jnp.uint64(off)
+                    col = (col & ~mask) | (v & mask)
+                else:  # ("or", v)
+                    col = col | op[1]
+            cols.append(col[..., None])
+        return jnp.concatenate(cols, axis=-1)
+
+
 class BitPacker:
     """Packs named bit fields into u64 words; fields never straddle words.
 

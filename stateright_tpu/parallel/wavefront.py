@@ -180,7 +180,8 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
                   steps: int, target: Optional[int], pallas: bool = False,
                   sym: bool = False, cand: Optional[int] = None,
                   checked: bool = False, prededup: bool = False,
-                  cartography: bool = False, por=None, spill=None):
+                  cartography: bool = False, por=None, spill=None,
+                  mxu=None):
     """Build ``(init_fn, run_fn)`` for fixed capacities.
 
     ``qcap`` is the queue high-water mark; the buffers are over-allocated by
@@ -215,6 +216,21 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     zero extra ops in the step jaxpr (the telemetry/checked/prededup
     contract, pinned by test).
 
+    ``mxu`` is the resolved MXU-recast config (``ops/mxu.MxuConfig``,
+    None = off; docs/roofline.md "Executing the hot-spot list"): three
+    flag-gated bytes-moved reductions executing the JX4xx hot-spot
+    ranking — ``coalesce`` traces the twin's scatter-coalesced step
+    kernel (``step_rows_coalesced``) when it provides one, ``slim_queue``
+    appends novel rows in ``batch``-sized chunks gated on ``n_new``
+    instead of one candidate-stack-wide window, and ``probe`` recasts
+    the bucket membership reductions as one blocked bitmapped
+    ``dot_general`` (``bucket_insert(probe_dot=True)``).  Off means zero
+    extra ops AND the exact pre-MXU jaxpr (the prededup contract); on,
+    counts/verdicts/traces are bit-identical — pinned by tests.
+    ``checked`` mode keeps the plain step under its checkify wrapper
+    (the coalesced kernel is a perf shape, not a debug surface); the
+    queue/probe recasts still apply.
+
     ``checked`` is the sanitizer's dynamic guard
     (``stateright_tpu/analysis/sanitizer.py``): the MODEL kernels
     (``property_masks`` + ``step_rows``) run under
@@ -231,6 +247,21 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     width, arity = tensor.width, tensor.max_actions
     m = batch * arity
     eff_cand = min(cand, m) if cand else m
+    # MXU-recast knobs (ops/mxu.py): resolved once here so the off path
+    # below stays literally the pre-MXU expressions (jaxpr pin)
+    from ..ops.mxu import coalesced_step_fn
+
+    step_rows_fn = coalesced_step_fn(tensor, mxu)
+    probe_dot = bool(mxu is not None and mxu.probe)
+    # the slim chunk width must DIVIDE the candidate stack: a final
+    # dynamic_slice whose start clamps would misalign the written rows
+    # (queue corruption).  Every shipped config is a power-of-two
+    # multiple; an exotic cand budget statically falls back to the
+    # plain window (a build-time decision — both are Python ints here).
+    qchunk = min(batch, eff_cand)
+    slim_queue = bool(
+        mxu is not None and mxu.slim_queue and eff_cand % qchunk == 0
+    )
     # POR's cycle proviso appends a SECOND novel window per step (at
     # tail + n_new): over-allocate one more window so both appends stay
     # in bounds without clamping — a clamped dynamic_update_slice would
@@ -334,6 +365,59 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
     )
     poison_fn = getattr(tensor, "poison_rows", None)
 
+    def append_novel(qrows, qfp, qebits, qdepth, tail0, sel, n_new,
+                     crows, cfp, cebt, cdep):
+        """Append the novel-compacted ``sel`` prefix at ``tail0``.
+
+        Plain path: one candidate-stack-wide window per buffer (the
+        pre-MXU expressions verbatim — jaxpr pin).  Slim-queue path
+        (``mxu.slim_queue``): ``qchunk``-sized chunks gated on
+        ``n_new``, so the gather + ``dynamic_update_slice`` windows the
+        roofline ledger charges track the NOVEL count, not the padded
+        stack (queue rows 1-3 of docs/roofline.md's tables).  ``qchunk``
+        divides ``eff_cand`` (enforced at build time), so no chunk's
+        slice start ever clamps and the last write ends at most at
+        ``tail0 + eff_cand`` — inside the same ``qalloc`` slack the
+        plain window uses; an overflowed batch (``n_new == 0``) writes
+        nothing, which only strengthens the replay contract."""
+        if not slim_queue:
+            qrows = jax.lax.dynamic_update_slice(
+                qrows, crows[sel], (tail0, jnp.int32(0))
+            )
+            qfp = jax.lax.dynamic_update_slice(qfp, cfp[sel], (tail0,))
+            qebits = jax.lax.dynamic_update_slice(
+                qebits, cebt[sel], (tail0,)
+            )
+            qdepth = jax.lax.dynamic_update_slice(
+                qdepth, cdep[sel], (tail0,)
+            )
+            return qrows, qfp, qebits, qdepth
+
+        def chunk(state):
+            k, qr, qf, qe, qd = state
+            off = k * qchunk
+            w_idx = jax.lax.dynamic_slice(sel, (off,), (qchunk,))
+            qr = jax.lax.dynamic_update_slice(
+                qr, crows[w_idx], (tail0 + off, jnp.int32(0))
+            )
+            qf = jax.lax.dynamic_update_slice(
+                qf, cfp[w_idx], (tail0 + off,)
+            )
+            qe = jax.lax.dynamic_update_slice(
+                qe, cebt[w_idx], (tail0 + off,)
+            )
+            qd = jax.lax.dynamic_update_slice(
+                qd, cdep[w_idx], (tail0 + off,)
+            )
+            return k + 1, qr, qf, qe, qd
+
+        _, qrows, qfp, qebits, qdepth = jax.lax.while_loop(
+            lambda s: s[0] * qchunk < n_new,
+            chunk,
+            (jnp.int32(0), qrows, qfp, qebits, qdepth),
+        )
+        return qrows, qfp, qebits, qdepth
+
     def step(carry):
         """Pop one batch, expand, dedup+insert, append novel rows."""
         (tfp, tpl, qrows, qfp, qebits, qdepth, head, tail,
@@ -371,7 +455,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         elive = live & ~all_discovered(disc)
 
         if not checked:
-            succ, valid = tensor.step_rows(rows)  # [B, A, W], [B, A]
+            succ, valid = step_rows_fn(rows)  # [B, A, W], [B, A]
         if boundary_fn is not None:
             # mirror the host checkers: out-of-boundary successors are
             # neither counted nor enqueued, and a state whose successors
@@ -439,15 +523,17 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
         tfp, tpl, sel, n_new, toverflow, coverflow = bucket_insert(
             tfp, tpl, cand_fp, cand_par, window=batch,
             use_pallas=pallas, generation_order=sym, compact=eff_cand,
+            probe_dot=probe_dot,
         )
         # Append novel rows (novel-compacted ``sel`` prefix) at the queue
         # tail.  Rows past ``n_new`` in the written window are garbage; they
         # sit in [tail+n_new, tail+eff_cand) which later appends overwrite
-        # before ``tail`` ever reaches them.
-        qrows = jax.lax.dynamic_update_slice(qrows, cand_rows[sel], (tail, jnp.int32(0)))
-        qfp = jax.lax.dynamic_update_slice(qfp, cand_fp[sel], (tail,))
-        qebits = jax.lax.dynamic_update_slice(qebits, cand_ebt[sel], (tail,))
-        qdepth = jax.lax.dynamic_update_slice(qdepth, cand_dep[sel], (tail,))
+        # before ``tail`` ever reaches them.  (Slim-queue mode writes only
+        # whole batch-chunks up to n_new; see append_novel.)
+        qrows, qfp, qebits, qdepth = append_novel(
+            qrows, qfp, qebits, qdepth, tail, sel, n_new,
+            cand_rows, cand_fp, cand_ebt, cand_dep,
+        )
 
         if por is not None:
             # conservative cycle proviso: a reduced row whose ample
@@ -466,16 +552,11 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             tfp, tpl, sel2, n_new2, tovf2, covf2 = bucket_insert(
                 tfp, tpl, cand_fp2, cand_par, window=batch,
                 use_pallas=pallas, generation_order=sym, compact=eff_cand,
+                probe_dot=probe_dot,
             )
-            qrows = jax.lax.dynamic_update_slice(
-                qrows, cand_rows[sel2], (tail1, jnp.int32(0))
-            )
-            qfp = jax.lax.dynamic_update_slice(qfp, cand_fp2[sel2], (tail1,))
-            qebits = jax.lax.dynamic_update_slice(
-                qebits, cand_ebt[sel2], (tail1,)
-            )
-            qdepth = jax.lax.dynamic_update_slice(
-                qdepth, cand_dep[sel2], (tail1,)
+            qrows, qfp, qebits, qdepth = append_novel(
+                qrows, qfp, qebits, qdepth, tail1, sel2, n_new2,
+                cand_rows, cand_fp2, cand_ebt, cand_dep,
             )
             toverflow = toverflow | tovf2
             coverflow = coverflow | covf2
@@ -696,6 +777,7 @@ def _build_engine(tensor, props, cap: int, qcap: int, batch: int,
             tfp, tpl, ifp,
             jnp.zeros((n_init,), jnp.uint64),  # parent 0 = "is an init state"
             window=n_init, use_pallas=pallas, generation_order=sym,
+            probe_dot=probe_dot,
         )
         qrows = jax.lax.dynamic_update_slice(
             qrows, irows[sel], (jnp.int32(0), jnp.int32(0))
@@ -819,7 +901,8 @@ def _carry_avals(tensor, n_props: int, cap: int, qcap: int, batch: int,
 
 
 def _build_inject(tensor, cap: int, qcap: int, batch: int,
-                  pallas: bool, sym: bool, checked: bool, spill):
+                  pallas: bool, sym: bool, checked: bool, spill,
+                  mxu=None):
     """Jitted pending-injection program for the spill tier: insert one
     host-VERIFIED batch of novel ``(fp, row, parent, ebits, depth)``
     tuples into the hot table + queue, bump ``unique``/``tail``, and
@@ -832,6 +915,7 @@ def _build_inject(tensor, cap: int, qcap: int, batch: int,
     width, arity = tensor.width, tensor.max_actions
     spill_bits, pend_cap = spill
     spill_start = (_ERR + 1) if checked else _ERR  # por never composes
+    probe_dot = bool(mxu is not None and mxu.probe)
 
     @jax.jit
     def inject_fn(carry, ifp, irows, ipar, iebt, idep, n):
@@ -841,7 +925,7 @@ def _build_inject(tensor, cap: int, qcap: int, batch: int,
         cfp = jnp.where(live, ifp, EMPTY)
         tfp, tpl, sel, n_new, tovf, _ = bucket_insert(
             tfp, tpl, cfp, ipar, window=min(batch, pend_cap),
-            use_pallas=pallas, generation_order=sym,
+            use_pallas=pallas, generation_order=sym, probe_dot=probe_dot,
         )
         qrows = jax.lax.dynamic_update_slice(
             qrows, irows[sel], (tail, jnp.int32(0))
@@ -983,6 +1067,26 @@ class TpuChecker(WavefrontChecker):
                self._prededup, self._cartography, self._por)
         if self._spill:
             key = key + (("spill",) + self._spill_cfg)
+        if self._mxu is not None:
+            # same discipline: MXU off leaves the key exactly the
+            # pre-MXU tuple (cache unkeyed by the feature's absence) —
+            # and the key carries the EFFECTIVE config, so component
+            # subsets that fall back to an identical program (no
+            # coalesced kernel on this twin; slim chunk width not
+            # dividing the candidate stack) share one cache entry
+            # instead of paying a duplicate engine compile
+            from ..ops.mxu import effective_mxu
+
+            eff = effective_mxu(self.tensor, self._mxu)
+            if eff is not None and eff.slim_queue:
+                m = batch * self.tensor.max_actions
+                ec = min(cand, m) if cand else m
+                if ec % min(batch, ec):
+                    eff = eff._replace(slim_queue=False)
+            if eff is not None and (
+                eff.coalesce or eff.slim_queue or eff.probe
+            ):
+                key = key + (eff.key(),)
         return key
 
     def _build(self, cap, qcap, batch, cand):
@@ -994,6 +1098,7 @@ class TpuChecker(WavefrontChecker):
             cartography=self._cartography,
             por=self._por_plan if self._por else None,
             spill=self._spill_cfg if self._spill else None,
+            mxu=self._mxu,
         )
 
     # -- memory-ledger hooks (telemetry/memory.py) ---------------------------
@@ -1031,10 +1136,11 @@ class TpuChecker(WavefrontChecker):
         tensor = self.tensor
         cap, qcap, batch = self._cap, self._qcap, self._batch
         cand, sym = self._cand, self._symmetry is not None
+        mxu = self._mxu
 
         def cost_fn():
             return wavefront_costs(
-                tensor, cap, qcap, batch, cand, sym=sym,
+                tensor, cap, qcap, batch, cand, sym=sym, mxu=mxu,
             )
 
         return cost_fn
@@ -1276,11 +1382,17 @@ class TpuChecker(WavefrontChecker):
         """The compiled pending-injection program for these capacities
         (rebuilt per growth rung, like the engine)."""
         key = (cap, qcap, batch)
+        if self._mxu is not None and self._mxu.probe:
+            # the inject program depends on the probe component only
+            # (off leaves the key exactly the pre-MXU tuple — the
+            # _engine_key discipline)
+            key = key + ("mxu-probe",)
         fn = self._inject_cache.get(key)
         if fn is None:
             fn = _build_inject(
                 self.tensor, cap, qcap, batch, self._pallas,
                 self._symmetry is not None, self._checked, self._spill_cfg,
+                mxu=self._mxu,
             )
             self._inject_cache[key] = fn
         return fn

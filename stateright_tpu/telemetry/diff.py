@@ -73,6 +73,9 @@ FLAG_CLASS = {
     "prewarm": "perf",
     "pallas": "perf",
     "compile_cache": "perf",
+    # the MXU recast knobs (ops/mxu.py): counts bit-identical by
+    # contract, program shapes differ — a pure perf delta
+    "mxu": "perf",
 }
 
 # non-flag config aspects -> contract class

@@ -152,6 +152,10 @@ def build_config(checker) -> dict:
         "checked": bool(getattr(checker, "_checked", False)),
         "prededup": bool(getattr(checker, "_prededup", False)),
         "spill": bool(getattr(checker, "_spill", False)),
+        # MXU recast round (ops/mxu.py): a perf-class knob — counts are
+        # contractually bit-identical, only the step program's shapes
+        # change (the diff engine classifies an on/off pair PERF-ONLY)
+        "mxu": getattr(checker, "_mxu", None) is not None,
         # active reduction only: a por() run that FELL BACK ran full
         # expansion and must diff as such (the fallback reason lives in
         # the por block)

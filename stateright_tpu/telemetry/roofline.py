@@ -44,47 +44,79 @@ ROOFLINE_V = 1
 
 ENV_DEVICE_SPEC = "STATERIGHT_TPU_DEVICE_SPEC"
 
-# peak dense-compute FLOPs (bf16 MXU — the ceiling the JX4xx recasts
-# chase) + HBM bytes/s per device kind, matched by substring against
-# jax's device_kind (lowercased).  Public datasheet numbers; the env
-# override wins for anything unlisted or for what-if planning.
+# peak dense-compute FLOPs per device kind — TWO ceilings, because a
+# stage is only entitled to the one its op mix can actually reach: the
+# bf16 MXU peak (what the JX4xx dot recasts chase) and the scalar/VPU
+# peak (what gather/scatter/elementwise pipelines top out at; a
+# recast-free stage judged against the MXU ridge would look absurdly
+# memory-bound, and a dot-recast stage judged against the VPU ridge
+# would claim compute-bound with the MXU still idle — the two-peak
+# split exists to stop both wrong verdicts).  MXU + HBM numbers are
+# public datasheets; VPU peaks are order-of-magnitude estimates
+# (vector lanes x clock), good enough for a ridge-side verdict.  The
+# env override wins for anything unlisted or for what-if planning.
+#
+# (needle, name, mxu_peak_flops, vpu_peak_flops, hbm_bytes_per_sec)
 DEVICE_SPECS = (
-    ("v6 lite", "tpu-v6e", 918e12, 1640e9),
-    ("v6e", "tpu-v6e", 918e12, 1640e9),
-    ("v5 lite", "tpu-v5e", 197e12, 819e9),
-    ("v5e", "tpu-v5e", 197e12, 819e9),
-    ("v5p", "tpu-v5p", 459e12, 2765e9),
-    ("v5", "tpu-v5e", 197e12, 819e9),
-    ("v4", "tpu-v4", 275e12, 1228e9),
-    ("v3", "tpu-v3", 123e12, 900e9),
-    ("v2", "tpu-v2", 45e12, 700e9),
+    ("v6 lite", "tpu-v6e", 918e12, 9.2e12, 1640e9),
+    ("v6e", "tpu-v6e", 918e12, 9.2e12, 1640e9),
+    ("v5 lite", "tpu-v5e", 197e12, 3.2e12, 819e9),
+    ("v5e", "tpu-v5e", 197e12, 3.2e12, 819e9),
+    ("v5p", "tpu-v5p", 459e12, 9e12, 2765e9),
+    ("v5", "tpu-v5e", 197e12, 3.2e12, 819e9),
+    ("v4", "tpu-v4", 275e12, 4.3e12, 1228e9),
+    ("v3", "tpu-v3", 123e12, 4e12, 900e9),
+    ("v2", "tpu-v2", 45e12, 3e12, 700e9),
 )
+
+# a stage "is" dot-class when dot ops carry at least half its FLOPs:
+# then the MXU ridge is the honest ceiling, else the VPU's
+DOT_DOMINANCE = 0.5
+
+
+def _spec_dict(name: str, mxu_peak: float, vpu_peak: float, bw: float,
+               src: str) -> dict:
+    """Normalized spec: both peaks, both ridges.  ``peak_flops``/
+    ``ridge`` keep the pre-split meaning (the MXU ceiling) so stored
+    artifacts and older consumers read unchanged."""
+    return {
+        "name": name,
+        "peak_flops": mxu_peak,  # back-compat alias of mxu_peak
+        "mxu_peak": mxu_peak,
+        "vpu_peak": vpu_peak,
+        "hbm_bytes_per_sec": bw,
+        "ridge": mxu_peak / bw,  # back-compat alias of mxu_ridge
+        "mxu_ridge": mxu_peak / bw,
+        "vpu_ridge": vpu_peak / bw,
+        "src": src,
+    }
 
 
 def device_spec(device=None) -> Optional[dict]:
-    """``{name, peak_flops, hbm_bytes_per_sec, ridge, src}`` for the
-    first JAX device (or ``device``), the env override winning; None
-    when nothing is known (CPU) — consumers degrade to
-    arithmetic-intensity-only, never crash."""
+    """``{name, mxu_peak, vpu_peak, hbm_bytes_per_sec, mxu_ridge,
+    vpu_ridge, src}`` (plus the pre-split ``peak_flops``/``ridge``
+    aliases of the MXU pair) for the first JAX device (or ``device``),
+    the env override winning; None when nothing is known (CPU) —
+    consumers degrade to arithmetic-intensity-only, never crash."""
     env = os.environ.get(ENV_DEVICE_SPEC, "").strip()
     if env:
         parts = env.split(":")
         try:
             peak, bw = float(parts[0]), float(parts[1])
-            if peak > 0 and bw > 0:
-                return {
-                    "name": parts[2] if len(parts) > 2 else "env-override",
-                    "peak_flops": peak,
-                    "hbm_bytes_per_sec": bw,
-                    "ridge": peak / bw,
-                    "src": "env",
-                }
+            vpu = float(parts[3]) if len(parts) > 3 else peak / 64.0
+            if peak > 0 and bw > 0 and vpu > 0:
+                return _spec_dict(
+                    parts[2] if len(parts) > 2 and parts[2]
+                    else "env-override",
+                    peak, vpu, bw, "env",
+                )
         except (IndexError, ValueError):
             pass
         print(
             "stateright-tpu: roofline: ignoring malformed "
             f"{ENV_DEVICE_SPEC}={env!r} (want PEAK_FLOPS:HBM_BYTES_PER_SEC"
-            "[:NAME], e.g. 1.97e14:8.19e11:tpu-v5e)",
+            "[:NAME[:VPU_PEAK_FLOPS]], e.g. 1.97e14:8.19e11:tpu-v5e:"
+            "3.2e12; VPU peak defaults to PEAK/64)",
             file=sys.stderr,
         )
     try:
@@ -97,35 +129,51 @@ def device_spec(device=None) -> Optional[dict]:
         return None
     if platform != "tpu":
         return None
-    for needle, name, peak, bw in DEVICE_SPECS:
+    for needle, name, peak, vpu, bw in DEVICE_SPECS:
         if needle in kind:
-            return {
-                "name": name,
-                "peak_flops": peak,
-                "hbm_bytes_per_sec": bw,
-                "ridge": peak / bw,
-                "src": "device",
-            }
+            return _spec_dict(name, peak, vpu, bw, "device")
     return None
+
+
+def stage_dot_dominated(stage: dict) -> bool:
+    """Does the stage's op mix earn the MXU ridge?  True when dot-class
+    ops carry at least :data:`DOT_DOMINANCE` of its FLOPs (from the
+    static block's per-class split) — the recast stages the JX4xx round
+    produces.  A stage with no FLOPs at all is never dot-dominated."""
+    classes = stage.get("classes") or {}
+    dot = (classes.get("dot") or {}).get("flops") or 0
+    total = stage.get("flops") or 0
+    return total > 0 and dot / total >= DOT_DOMINANCE
 
 
 def classify_stages(static: dict, spec: Optional[dict]) -> dict:
     """Per-stage roofline verdict from the static block's intensities:
-    ``memory-bound`` below the ridge point, ``compute-bound`` above,
-    ``unknown`` without a spec (CPU degradation) or without bytes."""
+    ``memory-bound`` below the stage's ridge point, ``compute-bound``
+    above, ``unknown`` without a spec (CPU degradation) or without
+    bytes.  Each stage is judged against the ridge its op mix can
+    actually reach: the MXU ridge when dot-class ops dominate its FLOPs
+    (the ``--mxu`` recasts), else the VPU ridge — one shared peak would
+    hand a recast stage the wrong verdict (pinned with a synthetic
+    dot-heavy stage in tests)."""
     out = {}
-    ridge = spec["ridge"] if spec else None
     for name, s in (static.get("stages") or {}).items():
         ai = s.get("intensity")
-        if ai is None:
-            verdict = "unknown"
-        elif ridge is None:
+        dot = stage_dot_dominated(s)
+        ridge = None
+        if spec:
+            ridge = (
+                spec.get("mxu_ridge", spec.get("ridge"))
+                if dot
+                else spec.get("vpu_ridge", spec.get("ridge"))
+            )
+        if ai is None or ridge is None:
             verdict = "unknown"
         else:
             verdict = "memory-bound" if ai < ridge else "compute-bound"
         entry = {"intensity": ai, "verdict": verdict}
         if ridge is not None:
             entry["ridge"] = round(ridge, 3)
+            entry["ridge_kind"] = "mxu" if dot else "vpu"
         out[name] = entry
     return out
 

@@ -333,3 +333,103 @@ def test_window_unique_shrinks_candidate_pressure():
         window=32, compact=64,
     )
     assert not bool(dedup[5]) and int(dedup[3]) > 0
+
+
+# -- BLEST one-hot membership probe (ops/mxu.py; docs/roofline.md) ------------
+
+
+def _insert_all(state, fps, payloads, probe_dot, window=8):
+    tfp, tpl = state
+    return bucket_insert(
+        tfp, tpl, jnp.asarray(np_u64(fps)), jnp.asarray(np_u64(payloads)),
+        window=window, probe_dot=probe_dot,
+    )
+
+
+# seed 0 rides the fast tier; the extra seeds follow the file's
+# random-stream precedent (870s tier-1 budget)
+@pytest.mark.parametrize(
+    "seed",
+    [0, pytest.param(1, marks=pytest.mark.slow),
+     pytest.param(2, marks=pytest.mark.slow)],
+)
+def test_blest_probe_matches_bucket_insert_on_random_streams(seed):
+    """``probe_dot=True`` must be a pure op-class recast: every output of
+    ``bucket_insert`` — table fingerprints, payloads, sel, n_new, both
+    overflow flags — bit-identical across 20 random windows salted with
+    EMPTY lanes and in-batch duplicates, tables evolved independently."""
+    rng = np.random.default_rng(seed)
+    nbuckets = 32
+    plain, dotted = fresh(nbuckets), fresh(nbuckets)
+    for _ in range(20):
+        m = int(rng.integers(1, 48))
+        fps = rng.integers(1, 1 << 40, m).astype(np.uint64)
+        fps[rng.random(m) < 0.25] = EMPTY
+        if m > 3:
+            fps[0] = fps[m // 2]  # in-batch duplicate
+        pay = rng.integers(1, 1 << 40, m).astype(np.uint64)
+        a = _insert_all(plain, fps, pay, probe_dot=False)
+        b = _insert_all(dotted, fps, pay, probe_dot=True)
+        plain, dotted = (a[0], a[1]), (b[0], b[1])
+        assert int(a[3]) == int(b[3])  # n_new
+        n = int(a[3])
+        assert np.array_equal(
+            np.asarray(a[2])[:n], np.asarray(b[2])[:n]
+        )  # consumed sel prefix
+        assert bool(a[4]) == bool(b[4]) and bool(a[5]) == bool(b[5])
+        assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        assert np.array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_blest_probe_full_bucket_overflow_parity():
+    """A bucket driven past SLOTS must overflow identically (flag on and
+    off) and leave both tables untouched."""
+    nbuckets = 4
+    fps, x = [], 1
+    while len(fps) < SLOTS + 1:
+        if int(bucket_of(np.uint64(x), nbuckets)) == 0:
+            fps.append(x)
+        x += 1
+    pay = list(range(1, len(fps) + 1))
+    a = _insert_all(fresh(nbuckets), fps, pay, probe_dot=False)
+    b = _insert_all(fresh(nbuckets), fps, pay, probe_dot=True)
+    assert bool(a[4]) and bool(b[4])  # both overflow
+    assert int(a[3]) == int(b[3]) == 0
+    assert table_contents((a[0], a[1])) == table_contents((b[0], b[1])) == {}
+    # and a FULL-but-not-overfull bucket still probes exactly
+    a = _insert_all(fresh(nbuckets), fps[:SLOTS], pay[:SLOTS], probe_dot=False)
+    b = _insert_all(fresh(nbuckets), fps[:SLOTS], pay[:SLOTS], probe_dot=True)
+    assert not bool(a[4]) and not bool(b[4])
+    assert int(a[3]) == int(b[3]) == SLOTS
+    assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    # re-probing the full bucket classifies every candidate a duplicate
+    a2 = _insert_all((a[0], a[1]), fps[:SLOTS], pay[:SLOTS], probe_dot=False)
+    b2 = _insert_all((b[0], b[1]), fps[:SLOTS], pay[:SLOTS], probe_dot=True)
+    assert int(a2[3]) == int(b2[3]) == 0 and not bool(b2[4])
+
+
+def test_blest_probe_unit_matches_reduction_pair():
+    """:func:`ops.mxu.blest_probe` against the reduce_or/reduce_sum pair
+    it replaces, on a hand-built line window: EMPTY lanes, full lines,
+    absent and present fingerprints."""
+    from stateright_tpu.ops.mxu import blest_probe
+
+    E = np.uint64(EMPTY)
+    lines = np_u64([
+        [E] * SLOTS,                              # empty line
+        [7] + [E] * (SLOTS - 1),                  # singleton, hit
+        [7] + [E] * (SLOTS - 1),                  # singleton, miss
+        list(range(100, 100 + SLOTS)),            # full line, hit at end
+        list(range(200, 200 + SLOTS)),            # full line, miss
+    ])
+    wfp = np_u64([3, 7, 9, 100 + SLOTS - 1, 5])
+    p, b = blest_probe(jnp.asarray(lines), jnp.asarray(wfp), EMPTY)
+    p, b = np.asarray(p), np.asarray(b)
+    exp_p = np.any(lines == wfp[:, None], axis=-1)
+    exp_b = np.sum(lines != E, axis=-1).astype(np.int32)
+    assert np.array_equal(p, exp_p) and p.tolist() == [
+        False, True, False, True, False
+    ]
+    assert np.array_equal(b, exp_b) and b.tolist() == [
+        0, 1, 1, SLOTS, SLOTS
+    ]

@@ -220,11 +220,22 @@ def test_device_spec_env_override_and_cpu_degradation(monkeypatch, capsys):
         assert spec is None  # arithmetic-intensity-only degradation
     monkeypatch.setenv(ENV_DEVICE_SPEC, "1.97e14:8.19e11:tpu-v5e")
     spec = device_spec()
-    assert spec == {
+    # the pre-split fields hold exactly (back-compat contract) ...
+    assert {
+        k: spec[k]
+        for k in ("name", "peak_flops", "hbm_bytes_per_sec", "ridge",
+                  "src")
+    } == {
         "name": "tpu-v5e", "peak_flops": 1.97e14,
         "hbm_bytes_per_sec": 8.19e11,
         "ridge": 1.97e14 / 8.19e11, "src": "env",
     }
+    # ... and the two-peak split rides along (MXU aliases the old pair;
+    # VPU defaults to PEAK/64 for the 3-field form — docs/roofline.md)
+    assert spec["mxu_peak"] == spec["peak_flops"]
+    assert spec["mxu_ridge"] == spec["ridge"]
+    assert spec["vpu_peak"] == 1.97e14 / 64.0
+    assert spec["vpu_ridge"] == spec["vpu_peak"] / 8.19e11
     monkeypatch.setenv(ENV_DEVICE_SPEC, "garbage")
     assert device_spec() is None or device_spec()["src"] != "env"
     assert "malformed" in capsys.readouterr().err
