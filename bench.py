@@ -58,6 +58,15 @@ flagged roofline ledgers embedded as ``tpu_paxos3_mxu_roofline`` /
 ``regress.py --mxu`` gates the before/after pair (expand+queue charged
 bytes drop >=30% on paxos-3; a dot-class dedup-insert op on 2pc-7).
 
+``BENCH_SWEEP=1`` adds the flag-gated hyper-batched sweep leg
+(docs/sweep.md): the paxos default family (``BENCH_SWEEP_N`` instances,
+alternating lossiness) as ONE sweep vs the same instances sequentially
+— per-instance count parity ASSERTED, compile amortization recorded
+(``tpu_sweep.engine_compiles`` vs ``sequential_engine_compiles``), and
+the ``tpu_sweep_states_per_sec`` /
+``tpu_sweep_sequential_states_per_sec`` aggregate-throughput pair;
+``regress.py --sweep`` gates the block's well-formedness and parity.
+
 Run ledger (docs/telemetry.md "Comparing runs"): with
 ``STATERIGHT_TPU_RUN_DIR`` set, EVERY device leg bench runs is archived
 into the persistent run registry (``telemetry/registry.py``) — one
@@ -1183,6 +1192,97 @@ def tpu_phase() -> dict:
             _mark("2pc7 mxu leg done")
         except Exception as e:  # noqa: BLE001 - same never-void rule
             out["tpu_2pc7_mxu_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
+    # flag-gated SWEEP leg (BENCH_SWEEP=1; docs/sweep.md): the paxos
+    # default family (alternating lossy/non-lossy single-client
+    # instances) checked as ONE hyper-batched sweep versus the same
+    # instances run sequentially.  Per-instance count parity is
+    # ASSERTED (a sweep that drifts cannot report a win), the engine
+    # compile count must equal the cohort count (the amortization the
+    # mode exists for: C compiles for N instances), and the aggregate
+    # throughput pair (tpu_sweep_states_per_sec vs
+    # tpu_sweep_sequential_states_per_sec) is the A/B the chip decides.
+    if os.environ.get("BENCH_SWEEP", "") == "1":
+        try:
+            from stateright_tpu.models.paxos import sweep_family
+
+            n_sw = int(os.environ.get("BENCH_SWEEP_N", "8") or 8)
+            _mark("compile (sweep cohorts)")
+            spec = sweep_family(n_sw)
+            caps_sw = dict(
+                capacity=1 << 15, batch=1024, steps_per_call=64,
+            )
+
+            def spawn_sw():
+                # the A/B must be FLAG-only (the BENCH_MXU rule): same
+                # telemetry set as the sequential legs below, and the
+                # per-instance registry archive happens OUTSIDE the
+                # timed window — report building walks discovery paths
+                # and must not bias the sweep side
+                b = spec.instances[0].model.checker().telemetry(
+                    capacity=2048
+                ).sweep(spec)
+                return b.spawn_tpu(sync=True, **caps_sw)
+
+            sw, dt_sw = timed(spawn_sw)
+            sw.join()
+            # sequential oracle: the SAME family, fresh models (fresh
+            # twins — each pays its own engine compile, which is the
+            # point), same engine knobs, same telemetry set
+            seq_spec = sweep_family(n_sw)
+            t_seq = time.monotonic()
+            seq_counts = {}
+            for inst in seq_spec.instances:
+                c1 = inst.model.checker().telemetry(
+                    capacity=2048
+                ).spawn_tpu(sync=True, **caps_sw)
+                seq_counts[inst.key] = (
+                    c1.unique_state_count(), c1.state_count(),
+                )
+            dt_seq = time.monotonic() - t_seq
+            if RUN_LEDGER_DIR:
+                # archive per-instance records AFTER both timed windows
+                sw._run_dir = RUN_LEDGER_DIR
+                sw._maybe_record_run()
+            mismatches = [
+                k for k in seq_counts
+                if (sw.results[k].unique, sw.results[k].states)
+                != seq_counts[k]
+            ]
+            if mismatches:
+                raise AssertionError(
+                    f"sweep-vs-sequential count drift: {mismatches}"
+                )
+            total_states = sw.state_count()
+            out["tpu_sweep_states_per_sec"] = round(
+                total_states / dt_sw, 1
+            )
+            out["tpu_sweep_sequential_states_per_sec"] = round(
+                total_states / dt_seq, 1
+            )
+            out["tpu_sweep"] = {
+                "instances": len(spec.instances),
+                "cohorts": len(sw.cohorts),
+                "engine_compiles": int(sw.engine_compiles),
+                "sequential_engine_compiles": len(seq_spec.instances),
+                "unique": sw.unique_state_count(),
+                "states": total_states,
+                "sec": round(dt_sw, 3),
+                "sequential_sec": round(dt_seq, 3),
+                "parity": "IDENTICAL",
+                "per_instance": {
+                    k: {"unique": int(sw.results[k].unique),
+                        "states": int(sw.results[k].states)}
+                    for k in seq_counts
+                },
+            }
+            if RUN_LEDGER_DIR:
+                out.setdefault("run_registry", {})["sweep"] = sw.run_id
+            _mark("sweep leg done")
+        except Exception as e:  # noqa: BLE001 - the flag-gated leg must
+            # never void the primary metric
+            out["tpu_sweep_error"] = f"{type(e).__name__}: {e}"
         _persist(out)
 
     # reference bench protocol on device.  All five configs compile — the

@@ -8,7 +8,8 @@ staleness and regressions LOUD:
     python regress.py [RUN.json] [--baseline=BENCH_VALIDATED.json]
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
-                      [--memory] [--spill] [--roofline] [--mxu] [--diff]
+                      [--memory] [--spill] [--roofline] [--mxu]
+                      [--sweep] [--diff]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -624,6 +625,97 @@ def mxu_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def sweep_verdict(run: dict, baseline: dict) -> dict:
+    """``--sweep``: the hyper-batched instance-sweep leg (docs/sweep.md).
+
+    The leg is FLAG-gated (``BENCH_SWEEP=1``), so absence never trips —
+    stale artifacts and pre-sweep baselines pass untouched (the
+    spill/mxu rule; unit-tested with injected artifacts).  When a fresh
+    run carries it:
+
+     - a crashed leg (``tpu_sweep_error``) is a gate failure, not a
+       skip;
+     - the block must be WELL-FORMED: positive instance/cohort/compile
+       counts, a per-instance map whose uniques are positive ints;
+     - count parity must have held (``parity == "IDENTICAL"`` — the leg
+       asserts per-instance unique/total equality against sequential
+       oracle runs of the same family);
+     - the amortization must be real: ``engine_compiles`` must equal
+       ``cohorts`` (one compiled program per shape cohort; the leg
+       pre-sizes, so growth recompiles indicate a broken sizing) and be
+       STRICTLY below ``sequential_engine_compiles`` whenever the sweep
+       spans fewer cohorts than instances.
+    """
+    out: dict = {}
+    problems = []
+    err = run.get("tpu_sweep_error")
+    blk = run.get("tpu_sweep")
+    present = bool(err) or blk is not None
+    if err:
+        problems.append(f"leg crashed: tpu_sweep: {err}")
+    if blk is not None and not isinstance(blk, dict):
+        problems.append("tpu_sweep block is not an object")
+        blk = None
+    if isinstance(blk, dict):
+        ints = {}
+        for k in ("instances", "cohorts", "engine_compiles",
+                  "sequential_engine_compiles"):
+            v = blk.get(k)
+            if not isinstance(v, int) or v <= 0:
+                problems.append(f"tpu_sweep.{k} missing/malformed: {v!r}")
+            else:
+                ints[k] = v
+        per = blk.get("per_instance")
+        if not isinstance(per, dict) or not per:
+            problems.append("tpu_sweep.per_instance missing/empty")
+        else:
+            bad = sorted(
+                k for k, v in per.items()
+                if not isinstance(v, dict)
+                or not isinstance(v.get("unique"), int)
+                or v["unique"] <= 0
+            )
+            if bad:
+                problems.append(
+                    f"tpu_sweep.per_instance malformed for {bad}"
+                )
+        if blk.get("parity") != "IDENTICAL":
+            problems.append(
+                f"tpu_sweep.parity={blk.get('parity')!r} (per-instance "
+                "counts must reconcile IDENTICAL against the sequential "
+                "oracles)"
+            )
+        if {"instances", "cohorts", "engine_compiles",
+                "sequential_engine_compiles"} <= set(ints):
+            out["amortization"] = {
+                "cohorts": ints["cohorts"],
+                "engine_compiles": ints["engine_compiles"],
+                "sequential": ints["sequential_engine_compiles"],
+            }
+            if ints["engine_compiles"] != ints["cohorts"]:
+                problems.append(
+                    f"tpu_sweep.engine_compiles={ints['engine_compiles']}"
+                    f" != cohorts={ints['cohorts']} (one compiled "
+                    "program per shape cohort is the contract; growth "
+                    "recompiles mean the leg's pre-sizing broke)"
+                )
+            if (
+                ints["cohorts"] < ints["instances"]
+                and not ints["engine_compiles"]
+                < ints["sequential_engine_compiles"]
+            ):
+                problems.append(
+                    "sweep paid as many engine compiles as the "
+                    "sequential runs — no amortization"
+                )
+    out["present"] = present
+    out["ok"] = not problems  # flag-gated: absence is not a failure
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_sweep"))
+    return out
+
+
 def diff_verdict(run: dict, baseline: dict) -> dict:
     """``--diff``: the contract-aware report diff
     (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
@@ -705,7 +797,7 @@ def main(argv=None, fleet=None) -> int:
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
-    roofline = diff = mxu = False
+    roofline = diff = mxu = sweep = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -730,6 +822,8 @@ def main(argv=None, fleet=None) -> int:
             roofline = True
         elif a == "--mxu":
             mxu = True
+        elif a == "--sweep":
+            sweep = True
         elif a == "--diff":
             diff = True
         else:
@@ -800,6 +894,13 @@ def main(argv=None, fleet=None) -> int:
         # (stale/pre-mxu baselines never trip — the spill rule)
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["mxu"]["ok"]
+    if sweep:
+        verdict["sweep"] = sweep_verdict(run, baseline)
+        # flag-gated leg: absence passes; a present-but-crashed,
+        # parity-breaking, or unamortized leg trips fresh runs only
+        # (stale/pre-sweep baselines never trip — the spill/mxu rule)
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["sweep"]["ok"]
     if diff:
         verdict["diff"] = diff_verdict(run, baseline)
         # same freshness rule: stale artifacts and pre-registry
@@ -902,6 +1003,19 @@ def main(argv=None, fleet=None) -> int:
             "bars (tpu_*_mxu_*; see stdout JSON) — a recast that drifts "
             "counts or moves no fewer bytes did not execute the hot-spot "
             "list (docs/roofline.md)\n"
+        )
+        return 1
+    if (
+        "sweep" in verdict
+        and verdict["fresh"]
+        and not verdict["sweep"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the sweep leg is malformed, crashed, drifted its "
+            "per-instance counts, or paid per-instance compiles "
+            "(tpu_sweep; see stdout JSON) — a sweep that does not "
+            "amortize compiles or reconcile per instance is not a sweep "
+            "(docs/sweep.md)\n"
         )
         return 1
     if (

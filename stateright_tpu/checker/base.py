@@ -54,6 +54,10 @@ class CheckerBuilder:
         # periodic crash-safe autosave (stateright_tpu/checkpoint.py,
         # docs/robustness.md); None = env default (STATERIGHT_TPU_AUTOSAVE)
         self.autosave_opts: Optional[dict] = None
+        # hyper-batched instance sweep (stateright_tpu/sweep/,
+        # docs/sweep.md); None = env default (STATERIGHT_TPU_SWEEP on
+        # models that define sweep_family)
+        self.sweep_spec = None
 
     # -- configuration -------------------------------------------------------
 
@@ -465,6 +469,32 @@ class CheckerBuilder:
         }
         return self
 
+    def sweep(self, spec) -> "CheckerBuilder":
+        """Check a whole model family in one device run
+        (``stateright_tpu/sweep/``; docs/sweep.md): ``spec`` is a
+        :class:`~stateright_tpu.sweep.SweepSpec` enumerating instances
+        (lossiness flags, bounds, initial values, table seeds).
+        ``spawn_tpu`` then returns a
+        :class:`~stateright_tpu.sweep.engine.SweepChecker`: instances
+        group into shape cohorts, each cohort compiles ONE wavefront
+        step program (per-instance constants gathered by a row tag),
+        and all instances of a cohort explore concurrently over a
+        shared visited table whose fingerprints are namespaced per
+        instance — so each instance's unique/total counts, property
+        verdicts, and discovery traces reconcile bit-identically
+        against its own sequential run (pinned by tests).
+
+        Contract (the registry's strongest form, by construction): with
+        no sweep requested, ``spawn_tpu`` builds exactly the pre-sweep
+        engine — step jaxpr bit-identical, engine cache unkeyed.  Env
+        equivalent: ``STATERIGHT_TPU_SWEEP=N`` on models that define
+        ``sweep_family(N)``.  A sweep composes with telemetry /
+        cartography / report / runs / timeout / target; it rejects
+        checked/por/spill/mxu/symmetry/prededup/autosave with guidance.
+        """
+        self.sweep_spec = spec
+        return self
+
     def checked(self, enabled: bool = True) -> "CheckerBuilder":
         """Checked execution mode: the sanitizer's DYNAMIC guard
         (``docs/analysis.md``).  The device wavefront runs the same
@@ -674,6 +704,43 @@ class CheckerBuilder:
         A static preflight audit runs first (``docs/analysis.md``): audit
         errors abort here, before any device work; silence deliberately
         with :meth:`skip_audit`."""
+        from ..sweep import resolve_sweep_spec
+
+        spec = resolve_sweep_spec(
+            getattr(self, "sweep_spec", None), self.model
+        )
+        if spec is not None:
+            if "n_devices" in kw or "mesh" in kw or kw.get("devices"):
+                raise NotImplementedError(
+                    "sweeps run on the single-device engine for now — "
+                    "drop the devices/mesh argument (docs/sweep.md)"
+                )
+            # audit once per distinct SHAPE of the family (the cohort
+            # grouping key: twin class + row layout + properties) —
+            # same-shape members share kernels, so auditing each would
+            # re-trace the same programs N times, while differently
+            # configured same-class members (lossy vs non-lossy paxos)
+            # still get their own preflight
+            from ..sweep.cohort import shape_signature
+
+            seen = set()
+            for inst in spec.instances:
+                try:
+                    sig = shape_signature(inst)
+                except Exception:  # noqa: BLE001 - twin failures surface
+                    sig = id(inst)  # in the audit below, per instance
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                saved = self.model
+                self.model = inst.model
+                try:
+                    self._preflight_audit()
+                finally:
+                    self.model = saved
+            from ..sweep.engine import SweepChecker
+
+            return SweepChecker(self, spec, **kw)
         self._preflight_audit()
         devices = kw.pop("devices", None)
         if devices is not None and devices != 1:

@@ -201,3 +201,68 @@ def fingerprint(obj: Any) -> int:
     """State fingerprint: nonzero stable 64-bit digest (reference
     ``src/lib.rs:303-311`` uses NonZeroU64; hash_words already avoids 0)."""
     return stable_hash(obj)
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint namespacing (hyper-batched instance sweeps; docs/sweep.md)
+# ---------------------------------------------------------------------------
+
+# Fixed seed for sweep table-seed scrambles — distinct from
+# FINGERPRINT_SEED; stable across processes/builds like the seed itself.
+SWEEP_NS_SEED = 0x53574545504E5331  # b"SWEEPNS1"
+
+# multiplicative inverses of the splitmix64 constants mod 2^64 (unmix64)
+_SM_M1_INV = pow(_SM_M1, -1, 1 << 64)
+_SM_M2_INV = pow(_SM_M2, -1, 1 << 64)
+
+
+def unmix64(h: int) -> int:
+    """Exact inverse of :func:`mix64` (splitmix64 is a bijection): undo
+    each xorshift (``y ^ y>>r ^ y>>2r ...`` until the shift leaves the
+    word) and multiply by the constants' modular inverses, in reverse
+    order."""
+    h &= MASK64
+    h = h ^ (h >> 31) ^ (h >> 62)
+    h = (h * _SM_M2_INV) & MASK64
+    h = h ^ (h >> 27) ^ (h >> 54)
+    h = (h * _SM_M1_INV) & MASK64
+    h = h ^ (h >> 30) ^ (h >> 60)
+    return h
+
+
+def sweep_ns_bits(n_instances: int) -> int:
+    """Namespace width of a sweep: how many LOW bits of the table sort
+    key (``mix64(fp)``) carry the instance tag.  Sweep-wide (derived
+    from the spec size, never the cohort split), so cohort grouping can
+    never change an instance's fingerprints.  The replaced bits are the
+    sweep's collision-risk price: two states of ONE instance collide
+    when the top ``64 - bits`` key bits agree — the 2^-64 class relaxed
+    to 2^-(64-bits), documented in docs/sweep.md."""
+    return max(1, (max(int(n_instances), 2) - 1).bit_length())
+
+
+def ns_fingerprint(fp: int, tag: int, seed: int, bits: int) -> int:
+    """Namespace a fingerprint for sweep instance ``tag``: replace the
+    LOW ``bits`` bits of the sort key ``mix64(fp)`` with the tag and
+    invert the mixer.  ORDER-PRESERVING by construction: within one
+    instance the table sort key keeps the sequential run's high-bit
+    order (same bucket, same relative candidate order), which is what
+    makes sweep discovery traces bit-identical to sequential runs;
+    across instances the tags make keys — hence fingerprints — disjoint.
+    ``seed != 0`` additionally XOR-scrambles the key's high bits
+    (hash-fuzzing sweeps re-seed the table layout; trace parity with the
+    unseeded sequential run is deliberately given up there).  The two
+    reserved values (0 = no-parent marker, 2^64-1 = the device
+    empty-slot sentinel) remap like :func:`hash_words`.  MUST match the
+    device ``ops.hashing.ns_hash`` bit-for-bit — sweep trace
+    reconstruction matches host states to device table entries through
+    this function."""
+    low = (1 << bits) - 1
+    key = mix64(fp & MASK64)
+    if seed:
+        key ^= mix64(fold64(SWEEP_NS_SEED, seed & MASK64)) & ~low & MASK64
+    key = (key & ~low & MASK64) | (tag & low)
+    h = unmix64(key)
+    if h == 0 or h == MASK64:
+        h = _SM_GAMMA
+    return h

@@ -46,6 +46,7 @@ def run_cli(
     costmodel: Optional[Callable[[list], None]] = None,
     compare: Optional[Callable[[list], None]] = None,
     supervise: Optional[Callable[[list], None]] = None,
+    sweep: Optional[Callable[[list], None]] = None,
     argv: Optional[list] = None,
 ) -> None:
     argv = sys.argv[1:] if argv is None else argv
@@ -83,6 +84,8 @@ def run_cli(
         compare(rest)
     elif cmd == "supervise" and supervise is not None:
         supervise(rest)
+    elif cmd == "sweep" and sweep is not None:
+        sweep(rest)
     else:
         print("USAGE:")
         print(usage)
@@ -121,6 +124,11 @@ def run_cli(
                   "[--expect=VERDICT]  # contract-aware run diff: "
                   "report files or registry run ids "
                   "(docs/telemetry.md \"Comparing runs\")")
+        if sweep is not None:
+            print("  <example> sweep [N] [--runs=DIR] [--batch=N] "
+                  "[--steps=N] [--capacity=N]  # hyper-batched instance "
+                  "sweep: one compiled program per shape cohort checks "
+                  "the whole family (docs/sweep.md)")
         if supervise is not None:
             print("  <example> supervise [ARGS] --autosave=DIR "
                   "[--every=SECS] [--keep=K] [--max-restarts=N] "
@@ -1028,6 +1036,80 @@ def make_compare_cmd() -> Callable:
     return _compare
 
 
+def pop_sweep_opts(rest: list) -> tuple:
+    """Strip the sweep verb's flags: ``(opts, rest)`` — ``runs``
+    (registry dir), ``batch``/``steps``/``capacity`` (engine knobs)."""
+    opts = {"runs": None, "batch": None, "steps": None, "capacity": None}
+    kept = []
+    for a in rest:
+        if a.startswith("--runs="):
+            opts["runs"] = a[len("--runs="):]
+        elif a.startswith("--batch="):
+            opts["batch"] = int(a[len("--batch="):])
+        elif a.startswith("--steps="):
+            opts["steps"] = int(a[len("--steps="):])
+        elif a.startswith("--capacity="):
+            opts["capacity"] = int(a[len("--capacity="):])
+        else:
+            kept.append(a)
+    return opts, kept
+
+
+def make_sweep_cmd(
+    family: Callable[[int], "object"], default_n: int = 8
+) -> Callable:
+    """The per-example ``sweep`` verb (docs/sweep.md): build the
+    example's default family (``family(N)`` -> SweepSpec), run it as ONE
+    device sweep, and print one line per instance plus the cohort/compile
+    summary the CI smoke greps."""
+
+    def cmd(rest):
+        opts, rest = pop_sweep_opts(rest)
+        n = int(rest[0]) if rest else default_n
+        spec = family(n)
+        print(
+            f"Sweeping {len(spec.instances)} instances in one device run "
+            "(docs/sweep.md)."
+        )
+        b = (
+            spec.instances[0].model.checker()
+            .telemetry(cartography=True)
+            .sweep(spec)
+        )
+        if opts["runs"]:
+            b = b.runs(opts["runs"])
+        kw = {}
+        if opts["batch"]:
+            kw["batch"] = opts["batch"]
+        if opts["steps"]:
+            kw["steps_per_call"] = opts["steps"]
+        if opts["capacity"]:
+            kw["capacity"] = opts["capacity"]
+        c = b.spawn_tpu(sync=True, **kw)
+        c.join()
+        for inst in spec.instances:
+            r = c.results[inst.key]
+            disc = ",".join(sorted(r.chains)) or "-"
+            print(
+                f"  {inst.key}: unique={r.unique} states={r.states} "
+                f"depth={r.max_depth} discoveries=[{disc}]"
+            )
+        print(
+            f"sweep: {len(spec.instances)} instances over "
+            f"{len(c.cohorts)} cohort(s), "
+            f"{c.engine_compiles} engine compile(s), total "
+            f"unique={c.unique_state_count()} "
+            f"states={c.state_count()}"
+        )
+        if opts["runs"]:
+            print(
+                f"sweep: registered {len(spec.instances)} instance "
+                f"record(s) under sweep_id={c.run_id} in {opts['runs']}"
+            )
+
+    return cmd
+
+
 def fleet_runs(args: Optional[list] = None, stream=None) -> int:
     """``runs [DIR]``: list the persistent run registry — one line per
     archived run (id, config_key, model/engine, headline) plus the
@@ -1049,15 +1131,17 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
     if not recs:
         print(f"runs: registry at {root} is empty", file=stream)
         return 0
-    for r in recs:
+    def line(r, indent: str = "") -> None:
         h = r.get("headline") or {}
         bits = [
-            str(r.get("run_id")),
+            indent + str(r.get("run_id")),
             str(r.get("config_key") or "-"),
             f"{r.get('model')}/{r.get('engine')}",
             f"unique={h.get('unique')}",
             f"done={h.get('done')}",
         ]
+        if r.get("instance_key"):
+            bits.insert(1, f"[{r['instance_key']}]")
         if h.get("states_per_sec") is not None:
             bits.append(f"{h['states_per_sec']}/s")
         if r.get("leg"):
@@ -1066,6 +1150,37 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
             bits.append(f"parent={r['parent_run_id']}")
         bits.append(str(r.get("generated_at") or ""))
         print("  ".join(bits), file=stream)
+
+    # sweep members group under one header row with a per-instance
+    # verdict strip ('*' = at least one discovery, '.' = none), in the
+    # ledger's append order (docs/sweep.md)
+    groups: list = []
+    by_sweep: dict = {}
+    for r in recs:
+        sid = r.get("sweep_id")
+        if sid:
+            g = by_sweep.get(sid)
+            if g is None:
+                g = by_sweep[sid] = {"sweep_id": sid, "members": []}
+                groups.append(g)
+            g["members"].append(r)
+        else:
+            groups.append(r)
+    for g in groups:
+        if "members" not in g:
+            line(g)
+            continue
+        strip = "".join(
+            "*" if (m.get("headline") or {}).get("discoveries") else "."
+            for m in g["members"]
+        )
+        print(
+            f"sweep {g['sweep_id']}  {len(g['members'])} instance(s)  "
+            f"verdicts [{strip}]",
+            file=stream,
+        )
+        for m in g["members"]:
+            line(m, indent="  ")
     trends = reg.trends(recs)
     print(
         f"runs: {len(recs)} archived over {len(trends)} config(s) at "

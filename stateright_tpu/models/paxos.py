@@ -48,6 +48,7 @@ from ._cli import (
     make_report_cmd,
     make_independence_cmd,
     make_sanitize_cmd,
+    make_sweep_cmd,
     pop_checked,
     pop_perf,
     pop_supervise_opts,
@@ -212,6 +213,11 @@ class PaxosModel(TensorBackedModel, ActorModel):
     neither supports fall back to structural fingerprints and CPU checking.
     Eligibility is derived from the live builder state."""
 
+    def sweep_family(self, n: int = 8):
+        """Default hyper-batched sweep for the STATERIGHT_TPU_SWEEP env
+        knob (docs/sweep.md): delegates to the module-level family."""
+        return sweep_family(n)
+
     def tensor_model(self):
         from ..actor.network import UnorderedNonDuplicatingNetwork
         from .paxos_tensor import MAX_CLIENTS, PaxosTensor
@@ -305,6 +311,31 @@ def _audit_models(rest=()):
     return [(f"paxos clients={c} servers=3", paxos_model(c, 3))]
 
 
+def sweep_family(n: int = 8):
+    """The paxos default sweep (docs/sweep.md; ``sweep`` verb +
+    ``STATERIGHT_TPU_SWEEP``): ``n`` single-client instances alternating
+    network lossiness — the non-lossy members run the hand-tuned twin,
+    the lossy ones the compiled per-instance twin, so the sweep spans
+    TWO shape cohorts (one engine compile each) and mixed table seeds
+    widen the hash-fuzz net; every member must reconcile against its own
+    sequential 482/265 (non-lossy) / lossy counts."""
+    from ..sweep import SweepInstance, SweepSpec
+
+    insts = []
+    for i in range(max(1, int(n))):
+        lossy = bool(i % 2)
+        m = paxos_model(1, 3)
+        if lossy:
+            m.lossy_network(True)
+        insts.append(SweepInstance(
+            f"paxos1-{'lossy-' if lossy else ''}i{i}",
+            m,
+            params={"clients": 1, "lossy": lossy, "seed": i // 2},
+            seed=i // 2,
+        ))
+    return SweepSpec(insts)
+
+
 def main(argv=None):
     def check(rest):
         client_count = int(rest[0]) if rest else 2
@@ -389,6 +420,7 @@ def main(argv=None):
         "  paxos check-tpu [CLIENT_COUNT] [TARGET_STATES]\n"
         "  paxos check-auto [CLIENT_COUNT]\n"
         "  paxos explore [CLIENT_COUNT] [ADDRESS]\n"
+        "  paxos sweep [N_INSTANCES]\n"
         "  paxos spawn",
         check,
         check_tpu=check_tpu,
@@ -404,6 +436,7 @@ def main(argv=None):
         costmodel=make_costmodel_cmd(_audit_models),
         compare=make_compare_cmd(),
         supervise=supervise,
+        sweep=make_sweep_cmd(sweep_family),
         argv=argv,
     )
 

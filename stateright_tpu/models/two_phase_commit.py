@@ -36,6 +36,7 @@ from ._cli import (
     make_report_cmd,
     make_independence_cmd,
     make_sanitize_cmd,
+    make_sweep_cmd,
     pop_checked,
     pop_perf,
     pop_supervise_opts,
@@ -88,6 +89,11 @@ class TwoPhaseSys(TensorBackedModel, Model):
 
     def tensor_model(self) -> "TwoPhaseTensor":
         return TwoPhaseTensor(self)
+
+    def sweep_family(self, n: int = 8):
+        """Default hyper-batched sweep for the STATERIGHT_TPU_SWEEP env
+        knob (docs/sweep.md): delegates to the module-level family."""
+        return sweep_family(n)
 
     def init_states(self):
         n = self.rm_count
@@ -389,6 +395,23 @@ def _audit_models(rest=()):
     return [(f"two_phase_commit rm={rm_count}", TwoPhaseSys(rm_count))]
 
 
+def sweep_family(n: int = 8):
+    """The 2pc default sweep (docs/sweep.md; ``sweep`` verb +
+    ``STATERIGHT_TPU_SWEEP``): ``n`` rm=3 instances under distinct table
+    seeds — same dynamics, disjoint fingerprint namespaces, ONE shape
+    cohort / ONE engine compile; a hash/table-seed fuzz whose per-seed
+    counts must all reconcile to the sequential 288/1146."""
+    from ..sweep import SweepInstance, SweepSpec
+
+    return SweepSpec([
+        SweepInstance(
+            f"2pc3-seed{i}", TwoPhaseSys(3),
+            params={"rm": 3, "seed": i}, seed=i,
+        )
+        for i in range(max(1, int(n)))
+    ])
+
+
 def main(argv=None):
     def check(rest):
         rm_count = int(rest[0]) if rest else 2
@@ -467,7 +490,8 @@ def main(argv=None):
         "  two_phase_commit check-tpu [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-sym-tpu [RESOURCE_MANAGER_COUNT]\n"
         "  two_phase_commit check-auto [RESOURCE_MANAGER_COUNT]\n"
-        "  two_phase_commit explore [RESOURCE_MANAGER_COUNT] [ADDRESS]",
+        "  two_phase_commit explore [RESOURCE_MANAGER_COUNT] [ADDRESS]\n"
+        "  two_phase_commit sweep [N_INSTANCES]",
         check,
         check_sym=check_sym,
         check_tpu=check_tpu,
@@ -483,6 +507,7 @@ def main(argv=None):
         costmodel=make_costmodel_cmd(_audit_models),
         compare=make_compare_cmd(),
         supervise=supervise,
+        sweep=make_sweep_cmd(sweep_family),
         argv=argv,
     )
 

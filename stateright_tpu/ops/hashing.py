@@ -65,6 +65,43 @@ def fold64(h: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     return mix64((h ^ w) + _GAMMA)
 
 
+# unmix64 constants: the splitmix64 multipliers' inverses mod 2^64
+from ..fingerprint import _SM_M1_INV, _SM_M2_INV  # noqa: E402
+
+_M1I = np.uint64(_SM_M1_INV)
+_M2I = np.uint64(_SM_M2_INV)
+
+
+def unmix64(h: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise inverse of :func:`mix64` (host mirror:
+    ``fingerprint.unmix64``)."""
+    h = h ^ (h >> jnp.uint64(31)) ^ (h >> jnp.uint64(62))
+    h = h * _M2I
+    h = h ^ (h >> jnp.uint64(27)) ^ (h >> jnp.uint64(54))
+    h = h * _M1I
+    h = h ^ (h >> jnp.uint64(30)) ^ (h >> jnp.uint64(60))
+    return h
+
+
+def ns_hash(fps: jnp.ndarray, ns_low: jnp.ndarray, ns_xor: jnp.ndarray,
+            bits: int) -> jnp.ndarray:
+    """Namespace fingerprints for hyper-batched instance sweeps
+    (``stateright_tpu/sweep/``, docs/sweep.md): replace the LOW ``bits``
+    bits of the table sort key ``mix64(fp)`` with the lane's instance
+    tag (``ns_low``), XOR the high bits with the lane's table-seed
+    scramble (``ns_xor``; all-zero for unseeded instances), and invert
+    the mixer — order-preserving within an instance, disjoint across
+    instances.  Reserved 0 / EMPTY remap like ``row_hash``.  Host
+    mirror: :func:`stateright_tpu.fingerprint.ns_fingerprint` —
+    bit-for-bit agreement is what lets per-instance traces reconstruct
+    from the shared visited table."""
+    low = np.uint64((1 << bits) - 1)
+    key = mix64(fps)
+    key = (key ^ ns_xor) & ~low | (ns_low & low)
+    h = unmix64(key)
+    return jnp.where((h == jnp.uint64(0)) | (h == EMPTY), _GAMMA, h)
+
+
 def row_hash(rows: jnp.ndarray) -> jnp.ndarray:
     """Fingerprint each row: ``uint64[..., W] -> uint64[...]``.
 

@@ -88,6 +88,26 @@ from .tensor_model import BitPacker, FieldWriter, TensorModel
 _K_OTHER, _K_PUT_OK, _K_GET_OK, _K_PUT_FAIL = 0, 1, 2, 3
 
 
+def _orl_hint(state) -> str:
+    """The cap-error hint for OrderedReliableLink wrapper states: name
+    the actually-unbounded fields instead of leaving the user to diff
+    200k closure states (the ORL sequencers grow forever unless capped).
+    Shared by the exact-cap error and the pre-closure estimate's
+    fail-fast error."""
+    from ..actor.ordered_reliable_link import LinkState
+
+    if not isinstance(state, LinkState):
+        return ""
+    return (
+        "; this is an OrderedReliableLink wrapper state — "
+        "next_send_seq/msgs_pending_ack/last_delivered_seqs "
+        "grow without bound when the wrapped actor keeps "
+        "sending; cap them with state_bound (worked recipe: "
+        "docs/compiling-actor-systems.md, 'Compiling "
+        "ORL-wrapped systems')"
+    )
+
+
 class CompileError(Exception):
     """The model is outside the compilable fragment."""
 
@@ -466,24 +486,9 @@ class CompiledActorTensor(TensorModel):
                 return -1, False
             code = len(self._states[i])
             if code >= max_s:
-                from ..actor.ordered_reliable_link import LinkState
-
-                hint = ""
-                if isinstance(s, LinkState):
-                    # name the actual unbounded fields instead of leaving
-                    # the user to diff 200k closure states: the ORL
-                    # wrapper's sequencers grow forever unless capped
-                    hint = (
-                        "; this is an OrderedReliableLink wrapper state — "
-                        "next_send_seq/msgs_pending_ack/last_delivered_seqs "
-                        "grow without bound when the wrapped actor keeps "
-                        "sending; cap them with state_bound (worked recipe: "
-                        "docs/compiling-actor-systems.md, 'Compiling "
-                        "ORL-wrapped systems')"
-                    )
                 raise CompileError(
                     f"actor {i} state universe exceeded {max_s}; "
-                    "tighten state_bound" + hint
+                    "tighten state_bound" + _orl_hint(s)
                 )
             self._states[i].append(s)
             self._state_code[i][s] = code
@@ -505,6 +510,88 @@ class CompiledActorTensor(TensorModel):
             self._env_code[env] = code
             work.append(("e", code))
             return code, True
+
+        # -- fail-fast cap estimate ------------------------------------------
+        # The eager closure can burn minutes of handler calls before an
+        # actor's universe hits max_s (measured: 85s for the 3-client
+        # per-channel paxos closure to FAIL).  Checkpoint every few
+        # thousand handler calls: once the largest universe passes
+        # max_s/8, extrapolate the recent states-per-call rate over the
+        # deliveries ALREADY queued — when that estimate clears the cap
+        # with a 2x margin at TWO consecutive checkpoints with a
+        # non-decaying rate, raise the cap error in seconds with the
+        # measured estimate, instead of grinding to the exact wall.
+        # Guarded against converging closures (whose production rate
+        # decays as the universe fills: the fleet's largest legit
+        # closure, paxos-2 at 4 servers, peaks at 22.5k states and never
+        # reaches the max_s/8 = 25k engage threshold): the blowup must
+        # already hold an eighth of the cap, keep producing at an
+        # undiminished rate across two windows, AND overshoot the cap
+        # 2x on queued work alone.  Escape hatch:
+        # STATERIGHT_TPU_CLOSURE_ESTIMATE=off.
+        import os as _os
+
+        est_env = _os.environ.get(
+            "STATERIGHT_TPU_CLOSURE_ESTIMATE", ""
+        ).lower()
+        est_on = est_env not in ("off", "0")
+        est_debug = est_env == "debug"
+        calls = 0
+        _CHECK_EVERY = 2048
+        next_check = _CHECK_EVERY
+        # (calls, states) at the previous checkpoint; previous window
+        # rate; consecutive over-bar checkpoints
+        last_state = [0, 0, 0.0, 0]
+
+        def _estimate_check() -> None:
+            sizes = [len(s) for s in self._states]
+            big = max(range(n), key=lambda i: sizes[i])
+            d_calls = calls - last_state[0]
+            d_states = sizes[big] - last_state[1]
+            prev_rate = last_state[2]
+            rate = d_states / max(d_calls, 1)
+            last_state[0], last_state[1] = calls, sizes[big]
+            last_state[2] = rate
+            if sizes[big] * 8 < max_s:
+                last_state[3] = 0
+                return
+            pending = 0
+            env_by_dst = [0] * n
+            for env in self._envs:
+                d = int(env.dst)
+                if d < n:
+                    env_by_dst[d] += 1
+            for item in work:
+                if item[0] == "s":
+                    pending += env_by_dst[item[1]]
+                else:
+                    d = int(self._envs[item[1]].dst)
+                    if d < n:
+                        pending += sizes[d]
+            estimate = sizes[big] + int(rate * pending)
+            decaying = prev_rate > 0 and rate < 0.5 * prev_rate
+            if est_debug:
+                print(
+                    f"closure-estimate: states={sizes[big]} calls={calls} "
+                    f"rate={rate:.3f} pending={pending} "
+                    f"estimate={estimate} decaying={decaying} "
+                    f"streak={last_state[3]}"
+                )
+            if estimate > 2 * max_s and not decaying:
+                last_state[3] += 1
+            else:
+                last_state[3] = 0
+            if last_state[3] >= 2:
+                raise CompileError(
+                    f"actor {big} state universe is on course to exceed "
+                    f"the {max_s}-state cap: {sizes[big]} states after "
+                    f"{calls} handler calls with {pending} deliveries "
+                    f"already queued, production rate undiminished "
+                    f"(pre-closure estimate ≥ {estimate}); "
+                    "tighten state_bound, or raise max_states_per_actor "
+                    "(escape hatch: STATERIGHT_TPU_CLOSURE_ESTIMATE=off)"
+                    + _orl_hint(self._states[big][-1])
+                )
 
         # seed from the real initial system state
         (init,) = m.init_states()
@@ -582,15 +669,21 @@ class CompiledActorTensor(TensorModel):
             if item[0] == "s":
                 _, i, s_code = item
                 process_timeout(i, s_code)
+                calls += 1
                 for e_code, env in enumerate(self._envs):
                     if int(env.dst) == i:
                         process(i, s_code, e_code)
+                        calls += 1
             else:
                 _, e_code = item
                 i = int(self._envs[e_code].dst)
                 if i < n:
                     for s_code in range(len(self._states[i])):
                         process(i, s_code, e_code)
+                        calls += 1
+            if est_on and calls >= next_check:
+                next_check = calls + _CHECK_EVERY
+                _estimate_check()
 
         # timers exist iff a timer can ever be SET: then (and only then)
         # the encoding carries timer bits and step_rows emits Timeout

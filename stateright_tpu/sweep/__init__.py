@@ -1,0 +1,30 @@
+"""Hyper-batched instance sweeps: one compiled program checks a whole
+model family (docs/sweep.md; the ROADMAP "Hyper-batched instance sweeps"
+item).
+
+The compiled twins are pure tensor programs over packed rows, so the
+model *parameters* can be batched too: a :class:`SweepSpec` enumerates a
+family of instances (lossiness flags, bounds, initial values, table
+seeds), groups them into **shape cohorts** (instances whose twins trace
+to structurally identical kernels — differing constants are lifted out
+and gathered per row by an instance *tag*), and the sweep engine
+(``sweep/engine.py``) runs each cohort as ONE wavefront over a shared
+visited table whose fingerprints are namespaced per instance
+(``ops.hashing.ns_hash`` / ``fingerprint.ns_word``), so instances never
+collide and every instance's counts, verdicts, and discovery traces
+reconcile bit-identically against its own sequential run.
+
+Surfaces: ``CheckerBuilder.sweep(SPEC)`` / the examples' ``sweep`` CLI
+verb (``--sweep`` routing) / ``STATERIGHT_TPU_SWEEP=N`` (models that
+define ``sweep_family``); one registry record per instance (tagged
+``sweep_id``) so ``_cli compare`` and the Explorer dashboard work per
+instance.
+"""
+
+from .spec import (  # noqa: F401
+    ENV_SWEEP,
+    SWEEP_V,
+    SweepInstance,
+    SweepSpec,
+    resolve_sweep_spec,
+)

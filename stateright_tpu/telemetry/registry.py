@@ -82,6 +82,13 @@ def index_record(doc: dict, checker=None, leg: Optional[str] = None) -> dict:
     }
     if doc.get("parent_run_id"):
         rec["parent_run_id"] = doc["parent_run_id"]
+    if doc.get("sweep_id"):
+        # sweep-instance archive (stateright_tpu/sweep/, docs/sweep.md):
+        # the sweep id groups the family's members in `_cli runs` and
+        # the Explorer run list; instance_key names this member
+        rec["sweep_id"] = doc["sweep_id"]
+        if doc.get("instance_key"):
+            rec["instance_key"] = doc["instance_key"]
     if leg:
         rec["leg"] = leg
     return rec

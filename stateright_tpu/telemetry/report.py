@@ -47,7 +47,13 @@ REPORT_V = 1
 # volatile identity/header fields stamped at write time — everything a
 # cross-run diff must ignore lives HERE (telemetry/diff.py consults this
 # tuple at diff time; never hand-list these downstream)
-VOLATILE_KEYS = ("generated_at", "run_id", "parent_run_id")
+VOLATILE_KEYS = (
+    "generated_at", "run_id", "parent_run_id",
+    # sweep-instance archives (stateright_tpu/sweep/, docs/sweep.md):
+    # the sweep's run id + the member key ride the header so a sweep
+    # instance diffs cleanly against its sequential oracle run
+    "sweep_id", "instance_key",
+)
 
 # growth-record fields that are count-derived (the record's ``t``/``seq``
 # are wall-clock/ordering bookkeeping and stay out of the report body)
@@ -156,6 +162,10 @@ def build_config(checker) -> dict:
         # contractually bit-identical, only the step program's shapes
         # change (the diff engine classifies an on/off pair PERF-ONLY)
         "mxu": getattr(checker, "_mxu", None) is not None,
+        # sweep membership (stateright_tpu/sweep/): per-instance counts
+        # are contractually bit-identical to the sequential run, so the
+        # diff engine classes the flag "identical" (docs/sweep.md)
+        "sweep": bool(getattr(checker, "_is_sweep_instance", False)),
         # active reduction only: a por() run that FELL BACK ran full
         # expansion and must diff as such (the fallback reason lives in
         # the por block)

@@ -328,29 +328,78 @@ async function pollMetrics() {
 // the panel stays hidden (the /.metrics probe discipline).
 let runsAvailable = null;
 let diffSelection = []; // up to two selected run_ids
+let expandedSweeps = new Set(); // sweep_ids whose members are unfolded
+
+function makeRunRow(r, indent) {
+  const li = document.createElement("li");
+  li.className = "run-row";
+  if (indent) li.style.paddingLeft = "1.2em";
+  if (diffSelection.includes(r.run_id)) li.classList.add("selected");
+  const h = r.headline || {};
+  const id = document.createElement("span");
+  id.className = "run-id";
+  id.textContent = r.run_id.slice(0, 8);
+  id.title = r.run_id + "  config " + (r.config_key || "-");
+  const desc = document.createElement("span");
+  desc.textContent =
+    " " + (r.instance_key ? r.instance_key + " " : "") +
+    r.model + "/" + r.engine +
+    (r.leg ? " [" + r.leg + "]" : "") +
+    "  unique=" + (h.unique === undefined ? "-" : h.unique) +
+    (h.states_per_sec ? "  " + fmtRate(h.states_per_sec) : "") +
+    (r.parent_run_id ? "  ⤴" + r.parent_run_id.slice(0, 6) : "");
+  li.append(id, desc);
+  li.addEventListener("click", () => selectRunForDiff(r.run_id));
+  return li;
+}
 
 function renderRunsList(runs) {
   const ul = $("runs-list");
   ul.innerHTML = "";
-  for (const r of runs.slice(-30).reverse()) {
+  // sweep members fold under one expandable header row with a
+  // per-instance verdict strip (telemetry/registry.py sweep_id tags;
+  // docs/sweep.md)
+  const items = [];
+  const bySweep = new Map();
+  for (const r of runs.slice(-90)) {
+    if (r.sweep_id) {
+      let g = bySweep.get(r.sweep_id);
+      if (!g) {
+        g = { sweep_id: r.sweep_id, members: [] };
+        bySweep.set(r.sweep_id, g);
+        items.push(g);
+      }
+      g.members.push(r);
+    } else items.push(r);
+  }
+  for (const it of items.reverse().slice(0, 30)) {
+    if (!it.members) {
+      ul.appendChild(makeRunRow(it, false));
+      continue;
+    }
     const li = document.createElement("li");
-    li.className = "run-row";
-    if (diffSelection.includes(r.run_id)) li.classList.add("selected");
-    const h = r.headline || {};
+    li.className = "run-row sweep-row";
+    const open = expandedSweeps.has(it.sweep_id);
     const id = document.createElement("span");
     id.className = "run-id";
-    id.textContent = r.run_id.slice(0, 8);
-    id.title = r.run_id + "  config " + (r.config_key || "-");
+    id.textContent = (open ? "▾ " : "▸ ") + it.sweep_id.slice(0, 8);
+    id.title = "sweep " + it.sweep_id;
+    const strip = it.members
+      .map((m) =>
+        ((m.headline || {}).discoveries || []).length ? "●" : "○")
+      .join("");
     const desc = document.createElement("span");
     desc.textContent =
-      " " + r.model + "/" + r.engine +
-      (r.leg ? " [" + r.leg + "]" : "") +
-      "  unique=" + (h.unique === undefined ? "-" : h.unique) +
-      (h.states_per_sec ? "  " + fmtRate(h.states_per_sec) : "") +
-      (r.parent_run_id ? "  ⤴" + r.parent_run_id.slice(0, 6) : "");
+      " sweep · " + it.members.length + " instances  " + strip;
     li.append(id, desc);
-    li.addEventListener("click", () => selectRunForDiff(r.run_id));
+    li.addEventListener("click", () => {
+      if (open) expandedSweeps.delete(it.sweep_id);
+      else expandedSweeps.add(it.sweep_id);
+      pollRuns();
+    });
     ul.appendChild(li);
+    if (open)
+      for (const m of it.members) ul.appendChild(makeRunRow(m, true));
   }
 }
 

@@ -1,0 +1,130 @@
+"""Parametric model family for sweep tests (docs/sweep.md).
+
+``BoundedCounterSys(bound, counters)`` is a deliberately simple family
+whose *bound* parameter is twin DATA: every instance shares one row
+layout and one step-kernel structure, but the bound appears in the
+traced jaxpr (as a literal/constant), so a family of instances
+exercises the cohort unifier's constant lifting — one compiled program,
+genuinely different per-instance state spaces (the space is
+``(bound+1)^counters``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from stateright_tpu.core import Expectation, Model
+from stateright_tpu.parallel.tensor_model import (
+    BitPacker,
+    TensorBackedModel,
+    TensorModel,
+)
+
+_BITS = 6  # fixed field width: bounds up to 63 share one layout
+
+
+class BoundedCounterTensor(TensorModel):
+    def __init__(self, model):
+        self.model = model
+        self.n = model.n
+        self.bound = model.bound
+        self.pk = BitPacker([(f"c{i}", _BITS) for i in range(self.n)])
+        self.width = self.pk.width
+        self.max_actions = self.n
+
+    def init_rows(self) -> np.ndarray:
+        return np.asarray(
+            [self.encode_state(s) for s in self.model.init_states()],
+            np.uint64,
+        )
+
+    def encode_state(self, state) -> tuple:
+        return self.pk.pack(**{f"c{i}": v for i, v in enumerate(state)})
+
+    def decode_state(self, row):
+        d = self.pk.unpack(row)
+        return tuple(d[f"c{i}"] for i in range(self.n))
+
+    def step_rows(self, rows):
+        import jax.numpy as jnp
+
+        b = rows.shape[0]
+        base = jnp.broadcast_to(
+            rows[:, None, :], (b, self.n, self.width)
+        )
+        succ = base
+        valid_cols = []
+        for i in range(self.n):
+            v = self.pk.get(rows, f"c{i}")
+            # the BOUND is per-instance twin data: it lands in the
+            # traced jaxpr as a literal the cohort unifier lifts
+            ok = v < jnp.uint64(self.bound)
+            nv = jnp.where(ok, v + jnp.uint64(1), v)
+            col = self.pk.set(base[:, i, :], f"c{i}", nv)
+            succ = succ.at[:, i, :].set(col)
+            valid_cols.append(ok[:, None])
+        return succ, jnp.concatenate(valid_cols, axis=1)
+
+    def property_masks(self, rows):
+        import jax.numpy as jnp
+
+        vals = jnp.stack(
+            [self.pk.get(rows, f"c{i}") for i in range(self.n)], axis=-1
+        )
+        maxed = jnp.any(vals >= jnp.uint64(self.bound), axis=-1)
+        over = jnp.any(vals > jnp.uint64((1 << _BITS) - 1), axis=-1)
+        return jnp.stack([~over, maxed], axis=-1)
+
+
+class BoundedCounterSys(TensorBackedModel, Model):
+    """``counters`` independent counters, each incrementable to
+    ``bound``; "in range" always holds, "some counter maxed" is a
+    sometimes-example found at depth ``bound``."""
+
+    def __init__(self, bound: int, counters: int = 2):
+        if not 1 <= bound <= (1 << _BITS) - 1:
+            raise ValueError(f"bound must be in 1..{(1 << _BITS) - 1}")
+        self.bound = int(bound)
+        self.n = int(counters)
+
+    def properties(self):
+        from stateright_tpu.core import Property
+
+        return [
+            Property(
+                Expectation.ALWAYS, "in range",
+                lambda m, s: all(v <= m.bound for v in s),
+            ),
+            Property(
+                Expectation.SOMETIMES, "some counter maxed",
+                lambda m, s: any(v >= m.bound for v in s),
+            ),
+        ]
+
+    def init_states(self):
+        return [tuple(0 for _ in range(self.n))]
+
+    def actions(self, state):
+        return [i for i in range(self.n) if state[i] < self.bound]
+
+    def next_state(self, state, action):
+        out = list(state)
+        out[action] += 1
+        return tuple(out)
+
+    def tensor_model(self):
+        return BoundedCounterTensor(self)
+
+
+def bounded_counter_spec(bounds, counters: int = 2, seeds=None):
+    from stateright_tpu.sweep import SweepInstance, SweepSpec
+
+    return SweepSpec([
+        SweepInstance(
+            f"bc-b{b}",
+            BoundedCounterSys(b, counters),
+            params={"bound": int(b), "counters": int(counters)},
+            seed=(seeds[i] if seeds is not None else 0),
+        )
+        for i, b in enumerate(bounds)
+    ])

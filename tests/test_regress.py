@@ -573,6 +573,74 @@ def test_roofline_section_gates_fresh_runs_only(tmp_path, capsys):
     assert rc == 0 and v["roofline"]["baseline_present"] is True
 
 
+def test_sweep_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--sweep: the hyper-batched sweep leg (docs/sweep.md).  Flag-gated
+    like --spill/--mxu: absence (stale artifacts, pre-sweep baselines)
+    never trips; a present-but-crashed, parity-breaking, malformed, or
+    unamortized leg trips fresh runs only."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # pre-sweep: no tpu_sweep
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    blk = {
+        "instances": 8, "cohorts": 2, "engine_compiles": 2,
+        "sequential_engine_compiles": 8, "unique": 10572,
+        "states": 34716, "sec": 4.2, "sequential_sec": 9.1,
+        "parity": "IDENTICAL",
+        "per_instance": {
+            "paxos1-i0": {"unique": 265, "states": 482},
+            "paxos1-lossy-i1": {"unique": 2378, "states": 8197},
+        },
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_sweep": blk}
+    # absence never trips (pre-sweep artifacts pass untouched)
+    rc, v = run({"fresh": True,
+                 "tpu_paxos3_states_per_sec": 270000.0}, "--sweep")
+    assert rc == 0 and v["sweep"]["ok"] is True
+    assert v["sweep"]["present"] is False
+    assert v["sweep"]["baseline_present"] is False
+    # a well-formed leg passes and reports the amortization
+    rc, v = run(good, "--sweep")
+    assert rc == 0 and v["sweep"]["ok"] is True
+    assert v["sweep"]["amortization"]["engine_compiles"] == 2
+    # a crashed leg trips
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+                 "tpu_sweep_error": "AssertionError: drift"}, "--sweep")
+    assert rc == 1 and v["sweep"]["ok"] is False
+    # parity drift trips
+    bad = json.loads(json.dumps(blk))
+    bad["parity"] = "DRIFT"
+    rc, v = run({**good, "tpu_sweep": bad}, "--sweep")
+    assert rc == 1 and any(
+        "parity" in p for p in v["sweep"]["problems"]
+    )
+    # per-instance compiles (no amortization) trip
+    bad = json.loads(json.dumps(blk))
+    bad["engine_compiles"] = 8
+    rc, v = run({**good, "tpu_sweep": bad}, "--sweep")
+    assert rc == 1 and v["sweep"]["ok"] is False
+    # malformed/corrupt blocks produce a verdict, not a crash
+    for garbage in ("nope", {"instances": "x"}, {"per_instance": []}):
+        rc, v = run({**good, "tpu_sweep": garbage}, "--sweep")
+        assert rc == 1 and v["sweep"]["ok"] is False
+    # stale artifacts still exit 2; --allow-stale reports without gating
+    rc, v = run({"fresh": False, "tpu_sweep": blk}, "--sweep")
+    assert rc == 2
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0,
+                 "tpu_sweep": blk},
+                "--sweep", "--allow-stale")
+    assert rc == 0
+
+
 def test_diff_section_gates_fresh_runs_only(tmp_path, capsys):
     """--diff: the contract-aware report diff (telemetry/diff.py).
     Engages only when BOTH run and baseline embed a tpu_paxos3_report —

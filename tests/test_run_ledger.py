@@ -101,7 +101,7 @@ def test_report_carries_config_and_run_identity(tmp_path):
     assert cfg["key"] == config_key(cfg)
     for flag in ("telemetry", "cartography", "memory", "checked",
                  "prededup", "spill", "por", "symmetry", "prewarm",
-                 "pallas", "compile_cache", "roofline"):
+                 "pallas", "compile_cache", "roofline", "sweep"):
         assert flag in cfg["flags"], flag
     # different instance arguments -> different config_key
     from stateright_tpu.telemetry.report import build_config

@@ -123,6 +123,16 @@ SCHEMA = {
         {"v": int, "attempt": int, "reason": str},
         {"parent_run_id": str, "degradation": str},
     ),
+    "sweep": (
+        # hyper-batched instance sweeps (stateright_tpu/sweep/,
+        # docs/sweep.md): cohort_compile (one per compiled shape
+        # cohort), instance_done (per-instance totals at extraction),
+        # summary (instances/cohorts/compile amortization at run end)
+        {"v": int, "event": str},
+        {"cohort": int, "instances": int, "width": int, "arity": int,
+         "unified": bool, "key": str, "unique": int, "states": int,
+         "depth": int, "cohorts": int, "engine_compiles": int},
+    ),
     "memory": (
         # the HBM ledger's per-rung snapshot (telemetry/memory.py):
         # per-buffer analytic bytes + the growth-transient forecast;
@@ -304,6 +314,39 @@ def test_checkpoint_fault_restart_records_match_the_golden_schema(tmp_path):
     assert not problems, "\n".join(problems)
     # the summary carries the durability block alongside the others
     assert lines[0]["summary"]["durability"]["restarts"] == 1
+
+
+def test_sweep_records_match_the_golden_schema(tmp_path):
+    """A two-instance sweep emits the versioned ``sweep`` record kind
+    (cohort_compile / instance_done / summary), every record validated
+    field-by-field, and the export round-trips through from_jsonl."""
+    from stateright_tpu.models.two_phase_commit import sweep_family
+    from stateright_tpu.telemetry import FlightRecorder
+
+    spec = sweep_family(2)
+    c = (
+        spec.instances[0].model.checker().telemetry()
+        .sweep(spec)
+        .spawn_tpu(sync=True, capacity=1 << 12, batch=64)
+    )
+    path = tmp_path / "export.jsonl"
+    c.flight_recorder.to_jsonl(path)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines() if ln]
+    records = [ln for ln in lines if ln.get("kind") != "header"]
+    sweeps = [r for r in records if r["kind"] == "sweep"]
+    events = [r["event"] for r in sweeps]
+    assert events.count("cohort_compile") == 1
+    assert events.count("instance_done") == 2
+    assert events[-1] == "summary"
+    problems = []
+    for r in records:
+        problems += _check_record(r)
+    assert not problems, "\n".join(problems)
+    # round-trip: the restored ring carries the same sweep records
+    rec2 = FlightRecorder.from_jsonl(path)
+    assert [
+        (r["event"], r.get("key")) for r in rec2.records("sweep")
+    ] == [(r["event"], r.get("key")) for r in sweeps]
 
 
 def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
