@@ -1285,6 +1285,83 @@ def tpu_phase() -> dict:
             out["tpu_sweep_error"] = f"{type(e).__name__}: {e}"
         _persist(out)
 
+    # flag-gated FLEET leg (BENCH_FLEET=1; docs/fleet.md): a small
+    # multi-tenant job mix (three packable 2pc-3 tenants + a 2pc-4
+    # singleton) scheduled over a BENCH_FLEET_SLOTS pool versus the same
+    # jobs run one at a time.  Per-job count parity vs the solo runs is
+    # ASSERTED (a scheduler that drifts cannot report a win), the packed
+    # cohort must compile strictly fewer engines than jobs, and the
+    # aggregate-throughput pair (tpu_fleet_states_per_sec vs
+    # tpu_fleet_sequential_states_per_sec) is the serving metric.
+    if os.environ.get("BENCH_FLEET", "") == "1":
+        try:
+            from stateright_tpu.checker.base import CheckerBuilder
+            from stateright_tpu.fleet import COMPLETED as _FLEET_DONE
+            from stateright_tpu.fleet import FleetSpec, Job, run_fleet
+            from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+            slots_fl = int(os.environ.get("BENCH_FLEET_SLOTS", "2") or 2)
+
+            def job_fl(key, n, packable):
+                return Job(
+                    key=key, packable=packable, capacity=1 << 13,
+                    batch=256,
+                    build=lambda n=n: CheckerBuilder(
+                        TwoPhaseSys(n)
+                    ).telemetry(capacity=2048),
+                )
+
+            jobs_fl = [
+                job_fl("2pc3-a", 3, True), job_fl("2pc3-b", 3, True),
+                job_fl("2pc3-c", 3, True), job_fl("2pc4", 4, False),
+            ]
+            _mark("fleet leg (pool run)")
+            t_fl = time.monotonic()
+            fl = run_fleet(
+                FleetSpec(jobs=jobs_fl, slots=slots_fl), stream=None
+            )
+            dt_fl = time.monotonic() - t_fl
+            # solo oracle: the SAME jobs one at a time, fresh builders,
+            # same engine knobs — each pays its own compile, which is
+            # exactly the overhead cohort packing amortizes
+            t_fseq = time.monotonic()
+            seq_fl = {}
+            for j in jobs_fl:
+                c1 = j.build().spawn_tpu(sync=True, **j.engine_kw())
+                seq_fl[j.key] = (
+                    c1.unique_state_count(), c1.state_count(),
+                )
+            dt_fseq = time.monotonic() - t_fseq
+            bad = [
+                k for k in seq_fl
+                if fl[k].status != _FLEET_DONE
+                or (fl[k].unique, fl[k].states) != seq_fl[k]
+            ]
+            if bad:
+                raise AssertionError(f"fleet-vs-solo count drift: {bad}")
+            total_fl = sum(r.states or 0 for r in fl.results.values())
+            out["tpu_fleet_states_per_sec"] = round(total_fl / dt_fl, 1)
+            out["tpu_fleet_sequential_states_per_sec"] = round(
+                total_fl / dt_fseq, 1
+            )
+            out["tpu_fleet"] = {
+                "jobs": len(jobs_fl),
+                "slots": int(fl.slots),
+                "completed": int(fl.completed),
+                "preemptions": int(fl.preemptions),
+                "engine_compiles": int(fl.engine_compiles),
+                "sequential_engine_compiles": len(jobs_fl),
+                "packed": sum(len(p["jobs"]) for p in fl.packed),
+                "states": int(total_fl),
+                "sec": round(dt_fl, 3),
+                "sequential_sec": round(dt_fseq, 3),
+                "parity": "IDENTICAL",
+            }
+            _mark("fleet leg done")
+        except Exception as e:  # noqa: BLE001 - same never-void rule
+            out["tpu_fleet_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
     # reference bench protocol on device.  All five configs compile — the
     # actor compiler gained ordered-FIFO network support in round 2
     # (parallel/actor_compiler.py), so lin-reg-3-ordered runs on device too

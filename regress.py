@@ -9,7 +9,7 @@ staleness and regressions LOUD:
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
                       [--memory] [--spill] [--roofline] [--mxu]
-                      [--sweep] [--diff]
+                      [--sweep] [--fleet] [--diff]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -716,6 +716,93 @@ def sweep_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def fleet_verdict(run: dict, baseline: dict) -> dict:
+    """``--fleet``: the multi-tenant fleet-scheduler leg (docs/fleet.md).
+
+    The leg is FLAG-gated (``BENCH_FLEET=1``), so absence never trips —
+    stale artifacts and pre-fleet baselines pass untouched (the
+    spill/mxu/sweep rule; unit-tested with injected artifacts).  When a
+    fresh run carries it:
+
+     - a crashed leg (``tpu_fleet_error``) is a gate failure, not a
+       skip;
+     - the block must be WELL-FORMED: positive job/slot/compile counts
+       and a non-negative preemption count;
+     - every job must have completed (``completed == jobs`` — a refused
+       or failed tenant voids the serving measurement);
+     - count parity must have held (``parity == "IDENTICAL"`` — the leg
+       asserts per-job unique/total equality against solo oracle runs);
+     - when any jobs were cohort-packed, the amortization must be real:
+       ``engine_compiles`` STRICTLY below ``sequential_engine_compiles``.
+    """
+    out: dict = {}
+    problems = []
+    err = run.get("tpu_fleet_error")
+    blk = run.get("tpu_fleet")
+    present = bool(err) or blk is not None
+    if err:
+        problems.append(f"leg crashed: tpu_fleet: {err}")
+    if blk is not None and not isinstance(blk, dict):
+        problems.append("tpu_fleet block is not an object")
+        blk = None
+    if isinstance(blk, dict):
+        ints = {}
+        for k in ("jobs", "slots", "completed", "engine_compiles",
+                  "sequential_engine_compiles"):
+            v = blk.get(k)
+            if not isinstance(v, int) or v <= 0:
+                problems.append(f"tpu_fleet.{k} missing/malformed: {v!r}")
+            else:
+                ints[k] = v
+        pre = blk.get("preemptions")
+        if not isinstance(pre, int) or pre < 0:
+            problems.append(
+                f"tpu_fleet.preemptions missing/malformed: {pre!r}"
+            )
+        if (
+            "jobs" in ints and "completed" in ints
+            and ints["completed"] != ints["jobs"]
+        ):
+            problems.append(
+                f"tpu_fleet.completed={ints['completed']} != "
+                f"jobs={ints['jobs']} (a refused or failed tenant "
+                "voids the serving measurement)"
+            )
+        if blk.get("parity") != "IDENTICAL":
+            problems.append(
+                f"tpu_fleet.parity={blk.get('parity')!r} (per-job "
+                "counts must reconcile IDENTICAL against the solo "
+                "oracles)"
+            )
+        packed = blk.get("packed")
+        if not isinstance(packed, int) or packed < 0:
+            problems.append(
+                f"tpu_fleet.packed missing/malformed: {packed!r}"
+            )
+        elif (
+            packed > 1
+            and {"engine_compiles",
+                 "sequential_engine_compiles"} <= set(ints)
+        ):
+            out["amortization"] = {
+                "packed": packed,
+                "engine_compiles": ints["engine_compiles"],
+                "sequential": ints["sequential_engine_compiles"],
+            }
+            if not ints["engine_compiles"] \
+                    < ints["sequential_engine_compiles"]:
+                problems.append(
+                    "fleet paid as many engine compiles as the solo "
+                    "runs despite packed cohorts — no amortization"
+                )
+    out["present"] = present
+    out["ok"] = not problems  # flag-gated: absence is not a failure
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_fleet"))
+    return out
+
+
 def diff_verdict(run: dict, baseline: dict) -> dict:
     """``--diff``: the contract-aware report diff
     (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
@@ -797,7 +884,7 @@ def main(argv=None, fleet=None) -> int:
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
-    roofline = diff = mxu = sweep = False
+    roofline = diff = mxu = sweep = fleet_gate = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -824,6 +911,8 @@ def main(argv=None, fleet=None) -> int:
             mxu = True
         elif a == "--sweep":
             sweep = True
+        elif a == "--fleet":
+            fleet_gate = True
         elif a == "--diff":
             diff = True
         else:
@@ -901,6 +990,14 @@ def main(argv=None, fleet=None) -> int:
         # (stale/pre-sweep baselines never trip — the spill/mxu rule)
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["sweep"]["ok"]
+    if fleet_gate:
+        verdict["fleet"] = fleet_verdict(run, baseline)
+        # flag-gated leg: absence passes; a present-but-crashed,
+        # parity-breaking, incomplete, or unamortized leg trips fresh
+        # runs only (stale/pre-fleet baselines never trip — the
+        # spill/mxu/sweep rule)
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["fleet"]["ok"]
     if diff:
         verdict["diff"] = diff_verdict(run, baseline)
         # same freshness rule: stale artifacts and pre-registry
@@ -1016,6 +1113,19 @@ def main(argv=None, fleet=None) -> int:
             "(tpu_sweep; see stdout JSON) — a sweep that does not "
             "amortize compiles or reconcile per instance is not a sweep "
             "(docs/sweep.md)\n"
+        )
+        return 1
+    if (
+        "fleet" in verdict
+        and verdict["fresh"]
+        and not verdict["fleet"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the fleet leg is malformed, crashed, drifted its "
+            "per-job counts, left tenants unfinished, or paid per-job "
+            "compiles despite packing (tpu_fleet; see stdout JSON) — a "
+            "scheduler that drifts or drops tenants is not a serving "
+            "tier (docs/fleet.md)\n"
         )
         return 1
     if (

@@ -168,6 +168,17 @@ def list_generations(root: str) -> list:
     return out
 
 
+def latest_gen_number(root: str) -> Optional[int]:
+    """The newest COMPLETE generation number, manifest-only — no npz
+    load.  The fleet scheduler's preempt/resume records read this (a
+    preempted job's resume point) without paying a snapshot
+    deserialization per bookkeeping line."""
+    for g, p in reversed(_gen_dirs(root)):
+        if _read_manifest(p) is not None:
+            return g
+    return None
+
+
 def latest_generation(root: str) -> Optional[tuple]:
     """``(snapshot_dict, manifest)`` of the newest COMPLETE generation,
     or None when the directory holds no resumable state.  A generation

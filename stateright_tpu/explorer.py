@@ -258,6 +258,11 @@ def _metrics_view(checker) -> Optional[dict]:
         "durability": (
             (dur_fn() if callable(dur_fn) else None) or rec.durability()
         ),
+        # fleet pool/queue block (stateright_tpu/fleet/, docs/fleet.md):
+        # slots, running/queued job keys, completion + preemption
+        # tallies; null unless the recorder belongs to a fleet
+        # scheduler (the UI's pool panel reads it)
+        "fleet": rec.fleet(),
     }
 
 

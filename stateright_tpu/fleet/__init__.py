@@ -1,0 +1,42 @@
+"""Fleet scheduling: multi-tenant checking-as-a-service over a device
+pool (docs/fleet.md; the ROADMAP "Checking as a service" item).
+
+Declare tenants as :class:`Job` entries in a :class:`FleetSpec`, then
+``run_fleet(spec)`` (or drive a :class:`FleetScheduler` yourself).
+Jobs are placed by PR 7 capacity plans (admission control), packed
+into PR 15 sweep cohorts where shapes unify, supervised by PR 13's
+``supervise()``, and preempted by health signal with autosave-backed
+exactly-once resume.  :mod:`~stateright_tpu.fleet.campaign` expands a
+parameter grid into a campaign with a durable ledger.
+
+Nothing here is imported by the engines: fleet off ⇒ zero coupling
+(step jaxpr and engine cache key bit-identical, pinned by
+tests/test_fleet.py).
+"""
+
+from .campaign import (  # noqa: F401
+    LEDGER_NAME,
+    build_ledger,
+    campaign_spec,
+    expand_grid,
+    run_campaign,
+)
+from .scheduler import (  # noqa: F401
+    PREEMPT_EVENTS,
+    FleetResult,
+    FleetScheduler,
+    run_fleet,
+)
+from .spec import (  # noqa: F401
+    ADMITTED,
+    ADMITTED_SPILL,
+    COMPLETED,
+    FAILED,
+    FLEET_V,
+    PREEMPTED,
+    REFUSED,
+    FleetSpec,
+    Job,
+    JobResult,
+    PreemptionPlan,
+)

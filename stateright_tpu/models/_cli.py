@@ -1142,6 +1142,8 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
         ]
         if r.get("instance_key"):
             bits.insert(1, f"[{r['instance_key']}]")
+        elif r.get("job_key"):
+            bits.insert(1, f"[{r['job_key']}]")
         if h.get("states_per_sec") is not None:
             bits.append(f"{h['states_per_sec']}/s")
         if r.get("leg"):
@@ -1153,19 +1155,27 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
 
     # sweep members group under one header row with a per-instance
     # verdict strip ('*' = at least one discovery, '.' = none), in the
-    # ledger's append order (docs/sweep.md)
+    # ledger's append order (docs/sweep.md); campaign jobs group the
+    # same way (docs/fleet.md) and win when a record carries both tags
+    # (a packed cohort member is a sweep instance owned by a campaign)
     groups: list = []
-    by_sweep: dict = {}
+    by_group: dict = {}
     for r in recs:
-        sid = r.get("sweep_id")
-        if sid:
-            g = by_sweep.get(sid)
-            if g is None:
-                g = by_sweep[sid] = {"sweep_id": sid, "members": []}
-                groups.append(g)
-            g["members"].append(r)
+        if r.get("campaign_id"):
+            gid = ("campaign", r["campaign_id"], "job")
+        elif r.get("sweep_id"):
+            gid = ("sweep", r["sweep_id"], "instance")
         else:
             groups.append(r)
+            continue
+        g = by_group.get(gid)
+        if g is None:
+            g = by_group[gid] = {
+                "kind": gid[0], "id": gid[1], "noun": gid[2],
+                "members": [],
+            }
+            groups.append(g)
+        g["members"].append(r)
     for g in groups:
         if "members" not in g:
             line(g)
@@ -1175,8 +1185,8 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
             for m in g["members"]
         )
         print(
-            f"sweep {g['sweep_id']}  {len(g['members'])} instance(s)  "
-            f"verdicts [{strip}]",
+            f"{g['kind']} {g['id']}  {len(g['members'])} {g['noun']}(s)"
+            f"  verdicts [{strip}]",
             file=stream,
         )
         for m in g["members"]:
@@ -1196,6 +1206,282 @@ def fleet_runs(args: Optional[list] = None, stream=None) -> int:
                 file=stream,
             )
     return 0
+
+
+# -- fleet / campaign verbs (fleet/; docs/fleet.md) --------------------------
+
+
+def _pop_fleet_opts(rest: list, defaults: dict) -> tuple:
+    """Strip the fleet/campaign verbs' shared flags: ``(opts, rest)``.
+    ``--slots``/``--budget``/``--spill``/``--no-pack`` shape the pool,
+    ``--root`` hosts autosaves + artifacts, ``--runs`` the registry,
+    ``--every`` the autosave cadence, ``--stall=KEY@STEP`` the
+    deterministic preemption injection (``--stall=none`` disables)."""
+    opts = dict(defaults)
+    kept = []
+    for a in rest:
+        if a.startswith("--slots="):
+            opts["slots"] = int(a[len("--slots="):])
+        elif a.startswith("--budget="):
+            opts["budget"] = int(a[len("--budget="):])
+        elif a == "--spill":
+            opts["spill"] = True
+        elif a == "--no-pack":
+            opts["pack"] = False
+        elif a.startswith("--root="):
+            opts["root"] = a[len("--root="):]
+        elif a.startswith("--runs="):
+            opts["runs"] = a[len("--runs="):]
+        elif a.startswith("--every="):
+            opts["every"] = float(a[len("--every="):])
+        elif a.startswith("--stall="):
+            opts["stall"] = a[len("--stall="):]
+        elif a.startswith("--max-restarts="):
+            opts["max_restarts"] = int(a[len("--max-restarts="):])
+        elif a.startswith("--id="):
+            opts["id"] = a[len("--id="):]
+        elif a.startswith("--grid="):
+            opts["grid"] = a[len("--grid="):]
+        else:
+            kept.append(a)
+    return opts, kept
+
+
+def _canned_fleet_jobs(runs_dir: Optional[str]) -> list:
+    """The ``fleet`` verb's six-tenant workload: three packable
+    TwoPhaseSys(3) jobs (one cohort, one compile), a TwoPhaseSys(4)
+    and a TwoPhaseSys(5) singleton, and a paxos single-client job —
+    mixed shapes over one pool, per docs/fleet.md "The chaos smoke"."""
+    from ..checker.base import CheckerBuilder
+    from ..fleet import Job
+    from .paxos import paxos_model
+    from .two_phase_commit import TwoPhaseSys
+
+    def twopc(n):
+        def build():
+            b = CheckerBuilder(TwoPhaseSys(n))
+            return b.runs(runs_dir) if runs_dir else b
+        return build
+
+    def paxos():
+        def build():
+            b = CheckerBuilder(paxos_model(1))
+            return b.runs(runs_dir) if runs_dir else b
+        return build
+
+    return [
+        Job(key="2pc-a", build=twopc(3), packable=True,
+            capacity=1 << 12, batch=256, params={"rm": 3}),
+        Job(key="2pc-b", build=twopc(3), packable=True,
+            capacity=1 << 12, batch=256, params={"rm": 3}),
+        Job(key="2pc-c", build=twopc(3), packable=True,
+            capacity=1 << 12, batch=256, params={"rm": 3}),
+        Job(key="2pc-4", build=twopc(4),
+            capacity=1 << 13, batch=256, params={"rm": 4}),
+        Job(key="2pc-5", build=twopc(5), priority=1,
+            capacity=1 << 14, batch=512, params={"rm": 5}),
+        Job(key="paxos-1", build=paxos(),
+            capacity=1 << 12, batch=256, params={"clients": 1}),
+    ]
+
+
+def _print_job_results(res, stream) -> None:
+    """One grep-able line per job result (the CI smoke's contract)."""
+    from ..fleet import COMPLETED
+
+    for r in res.results.values():
+        bits = [f"fleet job {r.key}: status={r.status}",
+                f"decision={r.decision}"]
+        if r.status == COMPLETED:
+            bits += [f"unique={r.unique}", f"states={r.states}",
+                     f"depth={r.max_depth}"]
+        if r.cohort:
+            bits.append(f"cohort={r.cohort}")
+        if r.preemptions:
+            bits.append(f"preemptions={r.preemptions}")
+        if r.run_id:
+            bits.append(f"run_id={r.run_id}")
+        if r.parent_run_id:
+            bits.append(f"parent_run_id={r.parent_run_id}")
+        if r.reason:
+            bits.append(f"reason={r.reason}")
+        print("  ".join(bits), file=stream)
+
+
+def _audit_lineage(res, runs_dir: Optional[str], stream) -> int:
+    """Exactly-once audit: every preempted-then-completed job must
+    compare IDENTICAL against its yielded parent (``contract:
+    lineage``); returns the worst compare exit code."""
+    from ..fleet import COMPLETED
+
+    rc = 0
+    for r in res.results.values():
+        if not (r.preemptions and r.status == COMPLETED):
+            continue
+        if not (runs_dir and r.run_id and r.parent_run_id):
+            print(
+                f"fleet lineage {r.key}: UNVERIFIABLE (no registry or "
+                "run ids; pass --runs=DIR)",
+                file=stream,
+            )
+            rc = rc or 1
+            continue
+        print(
+            f"fleet lineage {r.key}: parent={r.parent_run_id} "
+            f"child={r.run_id}",
+            file=stream,
+        )
+        code = compare_reports_cmd(
+            [r.parent_run_id, r.run_id, f"--registry={runs_dir}",
+             "--expect=IDENTICAL"],
+            stream=stream,
+        )
+        rc = rc or code
+    return rc
+
+
+def fleet_schedule(args: Optional[list] = None, stream=None) -> int:
+    """The ``fleet`` verb: canned multi-tenant chaos smoke — six mixed
+    2pc/paxos jobs over a simulated N-slot pool with one injected
+    stall-preemption (docs/fleet.md).  Every job must complete with its
+    pinned counts and the preempted job's resume must compare IDENTICAL
+    against its yielded parent (the line CI greps for ``contract:
+    lineage``).  Exit 0 iff all jobs completed and lineage verified."""
+    import tempfile
+
+    from ..fleet import FleetSpec, PreemptionPlan, run_fleet
+
+    stream = stream or sys.stdout
+    opts, rest = _pop_fleet_opts(list(args or []), {
+        "slots": 2, "budget": None, "spill": False, "pack": True,
+        "root": None, "runs": None, "every": 0.0, "stall": "2pc-5@5",
+        "max_restarts": 2,
+    })
+    if rest:
+        print(f"fleet: unknown argument(s) {rest}", file=stream)
+        return 2
+    root = opts["root"] or tempfile.mkdtemp(prefix="stateright-tpu-fleet-")
+    runs_dir = opts["runs"] or os.path.join(root, "runs")
+    jobs = _canned_fleet_jobs(runs_dir)
+    spec = FleetSpec(
+        jobs=jobs, slots=opts["slots"],
+        slot_budget_bytes=opts["budget"], spill=opts["spill"],
+        pack=opts["pack"], max_restarts=opts["max_restarts"],
+    )
+    plan = None
+    if opts["stall"] and opts["stall"] != "none":
+        key, _, step = opts["stall"].partition("@")
+        plan = PreemptionPlan({key: int(step or 3)})
+        print(
+            f"fleet: injecting a stall-preemption into {key} at step "
+            f"{int(step or 3)}",
+            file=stream,
+        )
+    print(
+        f"fleet: {len(jobs)} job(s) over {spec.slots} slot(s) "
+        f"(pack={spec.pack}, spill={spec.spill}, root={root})",
+        file=stream,
+    )
+    res = run_fleet(
+        spec, root=root, preemption=plan, every_secs=opts["every"],
+        stream=stream,
+    )
+    _print_job_results(res, stream)
+    print(
+        f"fleet: completed={res.completed} failed={res.failed} "
+        f"refused={res.refused} preemptions={res.preemptions} "
+        f"engine_compiles={res.engine_compiles} "
+        f"packed={sum(len(p['jobs']) for p in res.packed)} "
+        f"secs={res.secs:.1f}",
+        file=stream,
+    )
+    rc = 0 if (res.failed == 0 and res.refused == 0) else 1
+    return rc or _audit_lineage(res, runs_dir, stream)
+
+
+#: the campaign verb's named model factories: name -> (factory, default
+#: grid).  Factories take grid-point params as keyword arguments.
+_CAMPAIGN_FACTORIES = {
+    "2pc": (
+        lambda rm=3: __import__(
+            "stateright_tpu.models.two_phase_commit",
+            fromlist=["TwoPhaseSys"],
+        ).TwoPhaseSys(rm),
+        {"rm": [3, 4]},
+    ),
+    "paxos": (
+        lambda clients=1: __import__(
+            "stateright_tpu.models.paxos", fromlist=["paxos_model"],
+        ).paxos_model(clients),
+        {"clients": [1]},
+    ),
+}
+
+
+def fleet_campaign(args: Optional[list] = None, stream=None) -> int:
+    """The ``campaign`` verb: expand a parameter grid into fleet jobs,
+    schedule them over the pool, and write the campaign ledger
+    (docs/fleet.md "Campaigns").  ``campaign 2pc --grid='{"rm":[3,4]}'``
+    checks TwoPhaseSys at both sizes under one campaign id; the ledger
+    (per-job wall-clock, compile accounting, aggregate states/s) lands
+    at ``ROOT/campaign.json``.  Exit 0 iff no job failed."""
+    import json
+    import tempfile
+
+    from ..fleet import LEDGER_NAME, campaign_spec, run_campaign
+
+    stream = stream or sys.stdout
+    opts, rest = _pop_fleet_opts(list(args or []), {
+        "slots": 2, "budget": None, "spill": False, "pack": True,
+        "root": None, "runs": None, "every": 0.0, "stall": None,
+        "max_restarts": 2, "id": None, "grid": None,
+    })
+    name = rest[0] if rest else "2pc"
+    if name not in _CAMPAIGN_FACTORIES or len(rest) > 1:
+        print(
+            "usage: campaign [2pc|paxos] [--grid=JSON] [--root=DIR] "
+            "[--runs=DIR] [--slots=N] [--budget=BYTES] [--spill] "
+            "[--no-pack] [--id=CID]",
+            file=stream,
+        )
+        return 2
+    factory, grid = _CAMPAIGN_FACTORIES[name]
+    if opts["grid"]:
+        grid = json.loads(opts["grid"])
+    root = opts["root"] or tempfile.mkdtemp(
+        prefix="stateright-tpu-campaign-"
+    )
+    spec = campaign_spec(
+        factory, grid, campaign_id=opts["id"],
+        slots=opts["slots"], slot_budget_bytes=opts["budget"],
+        spill=opts["spill"], pack=opts["pack"],
+        max_restarts=opts["max_restarts"],
+        run_dir=opts["runs"] or os.path.join(root, "runs"),
+    )
+    print(
+        f"campaign {spec.campaign_id}: {len(spec.jobs)} job(s) from "
+        f"grid {json.dumps(grid, sort_keys=True)} over {spec.slots} "
+        f"slot(s) (root={root})",
+        file=stream,
+    )
+    res, ledger = run_campaign(
+        spec, root=root, every_secs=opts["every"], stream=stream,
+    )
+    _print_job_results(res, stream)
+    print(
+        f"campaign {spec.campaign_id}: completed={ledger['completed']} "
+        f"failed={ledger['failed']} refused={ledger['refused']} "
+        f"preemptions={ledger['preemptions']} "
+        f"engine_compiles={ledger['engine_compiles']} "
+        f"secs={ledger['secs']} total_states={ledger['total_states']} "
+        f"states_per_sec={ledger['states_per_sec']}",
+        file=stream,
+    )
+    print(
+        f"campaign: ledger written to {os.path.join(root, LEDGER_NAME)}",
+        file=stream,
+    )
+    return 0 if ledger["failed"] == 0 else 1
 
 
 # -- supervise verb (supervisor.py; docs/robustness.md) ----------------------
@@ -1537,6 +1823,10 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_runs(argv[1:]))
     if argv and argv[0] == "compare":
         raise SystemExit(compare_reports_cmd(argv[1:]))
+    if argv and argv[0] == "fleet":
+        raise SystemExit(fleet_schedule(argv[1:]))
+    if argv and argv[0] == "campaign":
+        raise SystemExit(fleet_campaign(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
@@ -1572,6 +1862,18 @@ def main(argv: Optional[list] = None) -> None:
     print("    contract-aware diff of two run reports (files or "
           "registry run ids); exit 1 on DIVERGENT or an --expect "
           "mismatch")
+    print("  python -m stateright_tpu.models._cli fleet [--slots=N] "
+          "[--root=DIR] [--runs=DIR] [--stall=KEY@STEP|none] "
+          "[--budget=BYTES] [--spill] [--no-pack]")
+    print("    multi-tenant chaos smoke: six mixed 2pc/paxos jobs over "
+          "a simulated pool with one injected stall-preemption; "
+          "verifies pinned counts + resume lineage (docs/fleet.md)")
+    print("  python -m stateright_tpu.models._cli campaign [2pc|paxos] "
+          "[--grid=JSON] [--root=DIR] [--runs=DIR] [--slots=N] "
+          "[--id=CID]")
+    print("    parameter-grid campaign over the fleet scheduler; "
+          "writes the ROOT/campaign.json ledger with per-job "
+          "wall-clock + aggregate states/s (docs/fleet.md)")
 
 
 if __name__ == "__main__":

@@ -99,12 +99,17 @@ class Attempt:
 class SupervisedRun:
     """What ``supervise`` returns: the completed checker plus the
     supervision trail (restart count, per-attempt outcomes, degradation
-    events) — the durability block's data source."""
+    events) — the durability block's data source.  ``yielded`` marks a
+    cooperative preemption (the ``yield_event`` hook): the checker is
+    PARTIAL — its final autosave generation is the resume point, and
+    calling ``supervise`` again on the same ``autosave_dir`` continues
+    it bit-identically (docs/fleet.md "Preemption")."""
 
     checker: object
     restarts: int
     attempts: list = field(default_factory=list)
     degradations: list = field(default_factory=list)
+    yielded: bool = False
 
     def __getattr__(self, name):
         # result-surface passthrough: totals/discoveries/report read
@@ -163,6 +168,7 @@ def supervise(
     seed: int = 0,
     spawn: Optional[Callable] = None,
     sleep: Callable[[float], None] = time.sleep,
+    yield_event=None,
     **spawn_kw,
 ) -> SupervisedRun:
     """Run ``builder``'s check under supervision; returns a
@@ -173,7 +179,17 @@ def supervise(
     a real path).  ``spawn`` maps ``(builder, resume, **spawn_kw)`` to a
     checker (default: ``spawn_tpu``); the supervisor joins it.
     ``sleep``/``seed`` exist so tests drive backoff deterministically
-    without wall clock."""
+    without wall clock.
+
+    ``yield_event`` is the cooperative-preemption hook (``fleet/``,
+    docs/fleet.md): a ``threading.Event`` that, once set, makes the
+    current attempt ``stop()`` at its next host sync — the engine's
+    stop path force-writes one final autosave generation
+    (stop-after-next-autosave), and ``supervise`` returns the PARTIAL
+    run with ``yielded=True`` instead of retrying.  No SIGKILL, no lost
+    work: calling ``supervise`` again on the same ``autosave_dir``
+    resumes from that generation bit-identically, with
+    ``parent_run_id`` lineage linked exactly as a crash-resume would."""
     if autosave_dir is None:
         import tempfile
 
@@ -233,6 +249,8 @@ def supervise(
                         fields["degradation"] = degradations[-1]
                     rec.record("restart", v=SUPERVISE_V, **fields)
                     rec.update_meta(restarts=restarts, supervised=True)
+                if yield_event is not None:
+                    _arm_yield_watch(checker, yield_event)
                 checker.join()
             except BaseException as e:  # noqa: BLE001 - classified below
                 cls = classify_failure(e)
@@ -275,15 +293,17 @@ def supervise(
                 )
                 sleep(delay)
                 continue
+            yielded = yield_event is not None and yield_event.is_set()
             attempts.append(Attempt(
-                n=len(attempts), outcome="completed",
+                n=len(attempts),
+                outcome="yielded" if yielded else "completed",
                 resumed_from_gen=manifest.get("gen") if manifest else None,
             ))
             checker._restarts = restarts
             checker._degradations = list(degradations)
             return SupervisedRun(
                 checker, restarts, attempts=attempts,
-                degradations=list(degradations),
+                degradations=list(degradations), yielded=yielded,
             )
     finally:
         # supervision state must not outlive the call: a later plain
@@ -306,6 +326,28 @@ def supervise(
                 os.environ.pop(ENV_DEVICE_BYTES, None)
             else:
                 os.environ[ENV_DEVICE_BYTES] = prior
+
+
+def _arm_yield_watch(checker, yield_event) -> None:
+    """Cooperative-preemption watcher (stop-after-next-autosave): when
+    the scheduler sets ``yield_event``, ask the engine to ``stop()`` at
+    its next host sync — the stop path force-writes one final autosave
+    generation (``parallel/_base._maybe_autosave(force=True)``), so the
+    yield loses ~zero work and the run resumes bit-identically from
+    that generation (pinned by tests/test_robustness.py).  The watcher
+    exits on its own once the attempt finishes; ``stop()`` on a done
+    checker is a no-op, so a late fire is harmless."""
+    import threading
+
+    def _watch():
+        while not yield_event.wait(0.02):
+            if checker.is_done():
+                return
+        checker.stop()
+
+    threading.Thread(
+        target=_watch, daemon=True, name="supervise-yield"
+    ).start()
 
 
 def _degrade_for_oom(

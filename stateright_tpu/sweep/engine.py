@@ -1220,6 +1220,13 @@ class SweepChecker(Checker):
                 doc = identity_doc(view, build_report(view))
                 doc["sweep_id"] = self.run_id
                 doc["instance_key"] = key
+                # a fleet-packed cohort (stateright_tpu/fleet/) tags
+                # its members with the campaign; the instance key IS
+                # the tenant's job key there
+                cid = getattr(self, "_campaign_id", None)
+                if cid:
+                    doc["campaign_id"] = str(cid)
+                    doc["job_key"] = key
                 # checker=None: the headline stays count-derived — the
                 # sweep recorder's wall clock is the whole family's, not
                 # this instance's

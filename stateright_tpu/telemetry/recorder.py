@@ -8,7 +8,7 @@ Record schema (every record):
  - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
    | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"memory"``
    | ``"roofline"`` | ``"checkpoint"`` | ``"fault"`` | ``"restart"``
-   | ``"note"``
+   | ``"sweep"`` | ``"fleet"`` | ``"job"`` | ``"note"``
 
 ``step`` records additionally carry the engine tag and cumulative counters
 (``states``, ``unique``) plus derived per-step deltas (``d_states``,
@@ -30,7 +30,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Optional
+from typing import Callable, Optional
 
 from .health import HealthTracker
 
@@ -103,6 +103,16 @@ class FlightRecorder:
         # autosave cadence/generations + supervised restart count); same
         # outside-the-ring discipline
         self._durability: Optional[dict] = None
+        # latest fleet pool/queue snapshot (stateright_tpu/fleet/): slot
+        # occupancy, queued/running/terminal job keys; same discipline —
+        # the scheduler refreshes it on every placement transition and
+        # the Explorer's pool panel reads it off ``/.metrics``
+        self._fleet: Optional[dict] = None
+        # in-band stall-injection seam (fleet.PreemptionPlan): called with
+        # the step ordinal inside step(), so a due injection lands its
+        # ``health`` record on the step that crosses the threshold — a
+        # polling injector can lose the race against a short run
+        self._stall_inject: Optional[Callable[[int], Optional[str]]] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -175,6 +185,11 @@ class FlightRecorder:
                 # exported events come back verbatim instead.
                 for ev in self._health.update(rec):
                     self._append_unlocked("health", ev, t=now)
+                if self._stall_inject is not None:
+                    why = self._stall_inject(self._kind_counts["step"])
+                    if why:
+                        for ev in self._health.force_stall(why):
+                            self._append_unlocked("health", ev, t=now)
             return rec
 
     def add(self, counter: str, n: float = 1) -> None:
@@ -284,11 +299,49 @@ class FlightRecorder:
         with self._lock:
             return dict(self._durability) if self._durability else None
 
+    def set_fleet(self, snap: Optional[dict]) -> None:
+        """Replace the latest fleet pool/queue snapshot
+        (``stateright_tpu/fleet/``: slot occupancy + queued/terminal job
+        keys) — the outside-the-ring discipline of the other feature
+        blocks.  ``None`` clears it."""
+        with self._lock:
+            self._fleet = dict(snap) if snap else None
+
+    def fleet(self) -> Optional[dict]:
+        """Latest fleet pool/queue snapshot, or None when this recorder
+        does not belong to a fleet scheduler."""
+        with self._lock:
+            return dict(self._fleet) if self._fleet else None
+
     def health(self) -> dict:
         """Live progress/health snapshot (health.py): phase, stall flag,
         novelty rate, EWMA throughput, drain ETA."""
         with self._lock:
             return self._health.snapshot()
+
+    def inject_stall(self, reason: str = "injected") -> None:
+        """Force the health model into a ``stall`` transition
+        (deterministic preemption injection — ``fleet.PreemptionPlan``).
+        The manufactured event rides the ring exactly like a detected
+        stall, so consumers (the fleet scheduler's preemption monitor,
+        the Explorer badge) cannot tell injection from detection — the
+        whole signal path downstream of detection is what gets
+        exercised.  The next step record with fresh inserts emits the
+        paired ``stall_cleared``, like any real stall."""
+        with self._lock:
+            for ev in self._health.force_stall(reason):
+                self._append_unlocked("health", ev)
+
+    def arm_stall_injection(
+        self, fn: Optional[Callable[[int], Optional[str]]]
+    ) -> None:
+        """Arm the in-band injection seam: ``fn(step_ordinal)`` runs
+        inside every :meth:`step` (under the lock — keep it cheap and
+        reentrancy-free) and a truthy return forces that reason's stall
+        transition on the SAME step.  A polling injector can lose the
+        race against a short run; this one cannot."""
+        with self._lock:
+            self._stall_inject = fn
 
     def close_run(self, done: bool = True) -> None:
         """Mark the run finished: the health phase transitions to ``done``
@@ -408,6 +461,7 @@ class FlightRecorder:
             durability = (
                 dict(self._durability) if self._durability else None
             )
+            fleet = dict(self._fleet) if self._fleet else None
         occ = [r for r in recs if r["kind"] == "occupancy"]
         out: dict = {
             **meta,
@@ -450,6 +504,8 @@ class FlightRecorder:
             out["roofline"] = roofline
         if durability is not None:
             out["durability"] = durability
+        if fleet is not None:
+            out["fleet"] = fleet
         if occ:
             keep = ("occupied", "load_factor", "max_bucket", "full_buckets",
                     "poisson_full_expect", "nbuckets")
@@ -485,6 +541,8 @@ class FlightRecorder:
                 self._roofline = dict(summary["roofline"])
             if summary.get("durability") and self._durability is None:
                 self._durability = dict(summary["durability"])
+            if summary.get("fleet") and self._fleet is None:
+                self._fleet = dict(summary["fleet"])
             if summary.get("states") is not None and self._last_step:
                 last_t = self._last_step[0]
                 self._last_step = (

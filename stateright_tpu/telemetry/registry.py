@@ -89,6 +89,14 @@ def index_record(doc: dict, checker=None, leg: Optional[str] = None) -> dict:
         rec["sweep_id"] = doc["sweep_id"]
         if doc.get("instance_key"):
             rec["instance_key"] = doc["instance_key"]
+    if doc.get("campaign_id"):
+        # fleet-campaign archive (stateright_tpu/fleet/, docs/fleet.md):
+        # the campaign id groups a fleet's jobs under one expandable
+        # row in `_cli runs` and the Explorer run list (the sweep
+        # pattern); job_key names the tenant
+        rec["campaign_id"] = doc["campaign_id"]
+        if doc.get("job_key"):
+            rec["job_key"] = doc["job_key"]
     if leg:
         rec["leg"] = leg
     return rec
@@ -122,6 +130,15 @@ class RunRegistry:
         if body is None:
             body = build_report(checker)
         doc = identity_doc(checker, body)
+        # fleet-campaign tags ride the checker (set by the scheduler's
+        # spawn wrapper) into the doc + index — volatile identity, like
+        # run_id/sweep_id (report.VOLATILE_KEYS)
+        cid = getattr(checker, "_campaign_id", None)
+        if cid:
+            doc["campaign_id"] = str(cid)
+            jk = getattr(checker, "_job_key", None)
+            if jk:
+                doc["job_key"] = str(jk)
         return self.record_doc(doc, checker=checker, leg=leg)
 
     def record_doc(

@@ -53,6 +53,10 @@ VOLATILE_KEYS = (
     # the sweep's run id + the member key ride the header so a sweep
     # instance diffs cleanly against its sequential oracle run
     "sweep_id", "instance_key",
+    # fleet-campaign archives (stateright_tpu/fleet/, docs/fleet.md):
+    # the campaign id + tenant key group a fleet's jobs in the run
+    # list, and a fleet job must diff IDENTICAL against its solo run
+    "campaign_id", "job_key",
 )
 
 # growth-record fields that are count-derived (the record's ``t``/``seq``

@@ -282,6 +282,32 @@ function renderHealth(h) {
   el.className = h.stalled ? "health stalled" : "health";
 }
 
+// Pool/queue panel over the /.metrics fleet block (fleet/scheduler.py
+// publishes a pool snapshot into the recorder; null outside fleet runs).
+function renderFleet(f) {
+  const sec = $("fleet");
+  if (!f) {
+    sec.hidden = true;
+    return;
+  }
+  sec.hidden = false;
+  $("fleet-summary").textContent =
+    "slots=" + f.slots + "  jobs=" + f.jobs +
+    "  completed=" + f.completed +
+    (f.preemptions ? "  preemptions=" + f.preemptions : "");
+  const ul = $("fleet-slots");
+  ul.innerHTML = "";
+  for (const label of f.running || []) {
+    const li = document.createElement("li");
+    li.className = "fleet-slot";
+    li.textContent = "▶ " + label;
+    ul.appendChild(li);
+  }
+  $("fleet-queue").textContent = (f.queued || []).length
+    ? "queued: " + f.queued.join("  ")
+    : "queue empty";
+}
+
 async function pollMetrics() {
   if (metricsAvailable === false) return;
   try {
@@ -315,6 +341,7 @@ async function pollMetrics() {
     renderCartography(m.cartography);
     renderMemory(m.memory, m.health);
     renderRoofline(m.roofline);
+    renderFleet(m.fleet);
   } catch (e) {
     /* transient; retry next poll */
   }
@@ -328,7 +355,7 @@ async function pollMetrics() {
 // the panel stays hidden (the /.metrics probe discipline).
 let runsAvailable = null;
 let diffSelection = []; // up to two selected run_ids
-let expandedSweeps = new Set(); // sweep_ids whose members are unfolded
+let expandedSweeps = new Set(); // sweep/campaign ids whose members unfold
 
 function makeRunRow(r, indent) {
   const li = document.createElement("li");
@@ -342,7 +369,8 @@ function makeRunRow(r, indent) {
   id.title = r.run_id + "  config " + (r.config_key || "-");
   const desc = document.createElement("span");
   desc.textContent =
-    " " + (r.instance_key ? r.instance_key + " " : "") +
+    " " + (r.instance_key ? r.instance_key + " " :
+           r.job_key ? r.job_key + " " : "") +
     r.model + "/" + r.engine +
     (r.leg ? " [" + r.leg + "]" : "") +
     "  unique=" + (h.unique === undefined ? "-" : h.unique) +
@@ -358,15 +386,19 @@ function renderRunsList(runs) {
   ul.innerHTML = "";
   // sweep members fold under one expandable header row with a
   // per-instance verdict strip (telemetry/registry.py sweep_id tags;
-  // docs/sweep.md)
+  // docs/sweep.md); campaign jobs fold the same way and win when a
+  // record carries both tags (a packed cohort member is a sweep
+  // instance owned by a campaign — docs/fleet.md)
   const items = [];
-  const bySweep = new Map();
+  const byGroup = new Map();
   for (const r of runs.slice(-90)) {
-    if (r.sweep_id) {
-      let g = bySweep.get(r.sweep_id);
+    const kind = r.campaign_id ? "campaign" : r.sweep_id ? "sweep" : null;
+    if (kind) {
+      const gid = kind + ":" + (r.campaign_id || r.sweep_id);
+      let g = byGroup.get(gid);
       if (!g) {
-        g = { sweep_id: r.sweep_id, members: [] };
-        bySweep.set(r.sweep_id, g);
+        g = { gid, kind, raw: r.campaign_id || r.sweep_id, members: [] };
+        byGroup.set(gid, g);
         items.push(g);
       }
       g.members.push(r);
@@ -379,22 +411,23 @@ function renderRunsList(runs) {
     }
     const li = document.createElement("li");
     li.className = "run-row sweep-row";
-    const open = expandedSweeps.has(it.sweep_id);
+    const open = expandedSweeps.has(it.gid);
     const id = document.createElement("span");
     id.className = "run-id";
-    id.textContent = (open ? "▾ " : "▸ ") + it.sweep_id.slice(0, 8);
-    id.title = "sweep " + it.sweep_id;
+    id.textContent = (open ? "▾ " : "▸ ") + it.raw.slice(0, 8);
+    id.title = it.kind + " " + it.raw;
     const strip = it.members
       .map((m) =>
         ((m.headline || {}).discoveries || []).length ? "●" : "○")
       .join("");
     const desc = document.createElement("span");
     desc.textContent =
-      " sweep · " + it.members.length + " instances  " + strip;
+      " " + it.kind + " · " + it.members.length +
+      (it.kind === "campaign" ? " jobs  " : " instances  ") + strip;
     li.append(id, desc);
     li.addEventListener("click", () => {
-      if (open) expandedSweeps.delete(it.sweep_id);
-      else expandedSweeps.add(it.sweep_id);
+      if (open) expandedSweeps.delete(it.gid);
+      else expandedSweeps.add(it.gid);
       pollRuns();
     });
     ul.appendChild(li);

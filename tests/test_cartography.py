@@ -748,7 +748,7 @@ def test_cartography_overhead_under_5pct_on_2pc7():
     )
 
 
-@pytest.mark.medium
+@pytest.mark.slow
 def test_cartography_full_crawl_reconciles_on_2pc7():
     """Full-crawl reconciliation at scale, through the real growth ladder
     (daily tier): the counters stay exact across hundreds of syncs and

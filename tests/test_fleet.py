@@ -1,0 +1,621 @@
+"""stateright_tpu/fleet/ — the multi-tenant fleet scheduler
+(docs/fleet.md).
+
+Unit tier (engine-free, tests/fleet_fakes.py): spec validation,
+admission decisions under simulated device budgets, cohort-pack
+grouping, the preempt→yield→re-queue→resume cycle with its record
+trail, campaign grids + ledgers, the CLI surfaces, and the
+zero-coupling contract (no engine module may import the fleet).
+
+Medium tier (real engines, CPU backend): the N-job acceptance — fleet
+counts bit-identical to solo runs, packed cohorts compiling strictly
+fewer engines than jobs, and a preempted job resuming exactly-once
+(lineage pair classifying IDENTICAL).
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from stateright_tpu.fleet import (
+    ADMITTED,
+    ADMITTED_SPILL,
+    COMPLETED,
+    FAILED,
+    FLEET_V,
+    LEDGER_NAME,
+    REFUSED,
+    FleetScheduler,
+    FleetSpec,
+    Job,
+    PreemptionPlan,
+    build_ledger,
+    campaign_spec,
+    expand_grid,
+    run_campaign,
+    run_fleet,
+)
+from stateright_tpu.telemetry import FlightRecorder
+
+from tests.fleet_fakes import FakeBuilder, FakeModel
+
+
+def _job(key, builder=None, **kw):
+    b = builder if builder is not None else FakeBuilder()
+    return Job(key=key, build=lambda: b, **kw)
+
+
+def _sched(jobs, **spec_kw):
+    return FleetScheduler(FleetSpec(jobs=jobs, **spec_kw), stream=None)
+
+
+def _build_2pc(n, **builder_calls):
+    def build():
+        from stateright_tpu.checker.base import CheckerBuilder
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        b = CheckerBuilder(TwoPhaseSys(n))
+        for name, arg in builder_calls.items():
+            b = getattr(b, name)(arg)
+        return b
+
+    return build
+
+
+# -- spec validation ---------------------------------------------------------
+
+
+def test_fleet_spec_validation():
+    with pytest.raises(ValueError):
+        FleetSpec(jobs=[])
+    with pytest.raises(ValueError):
+        FleetSpec(jobs=[_job("a"), _job("a")])  # duplicate keys
+    with pytest.raises(ValueError):
+        FleetSpec(jobs=[_job("a")], slots=0)
+    with pytest.raises(TypeError):
+        FleetSpec(jobs=[Job(key="a", build="not-callable")])
+    spec = FleetSpec(jobs=[_job("a"), _job("b")], slots=3)
+    assert spec.slots == 3 and len(spec.jobs) == 2
+
+
+def test_job_engine_kw_hints_then_overrides():
+    j = Job(key="a", build=lambda: FakeBuilder(), capacity=1 << 10,
+            batch=64, queue_capacity=2048, steps_per_call=8,
+            spawn_kw={"batch": 128})
+    kw = j.engine_kw()
+    assert kw["capacity"] == 1024
+    assert kw["batch"] == 128  # explicit spawn_kw wins over the hint
+    assert kw["queue_capacity"] == 2048 and kw["steps_per_call"] == 8
+
+
+def test_preemption_plan_is_one_shot_per_key():
+    p = PreemptionPlan({"a": 3})
+    assert not p.due("a", 2)
+    assert p.due("a", 3)
+    assert not p.due("a", 4)  # fired once, never again
+    assert not p.due("b", 99)  # unplanned keys never fire
+
+
+# -- admission (capacity_plan pricing under simulated budgets) ---------------
+
+
+def test_admission_host_side_and_unbudgeted_jobs_admit(monkeypatch):
+    monkeypatch.delenv("STATERIGHT_TPU_DEVICE_BYTES", raising=False)
+    # twin-less model: host-side check, no HBM ladder to price
+    j = _job("a")
+    d, reason, _b = _sched([j])._admit(j)
+    assert d == ADMITTED and "host-side" in reason
+    # a priced model with no budget known degrades to admission (the
+    # capacity verb's rule), loudly
+    jp = Job(key="2pc", build=_build_2pc(3), capacity=1 << 12, batch=256)
+    d, reason, _b = _sched([jp])._admit(jp)
+    assert d == ADMITTED and "budget" in reason
+    # ...and a roomy budget admits with nothing to report
+    monkeypatch.setenv("STATERIGHT_TPU_DEVICE_BYTES", str(100 * 10**9))
+    d, reason, _b = _sched([jp])._admit(jp)
+    assert d == ADMITTED and reason is None
+
+
+def test_admission_refuses_and_spills_under_budgets(monkeypatch):
+    # a budget the requested capacity cannot even start under: REFUSED
+    tiny = Job(key="2pc", build=_build_2pc(3), capacity=1 << 20,
+               batch=1024)
+    monkeypatch.setenv("STATERIGHT_TPU_DEVICE_BYTES", "1000000")
+    d, reason, _b = _sched([tiny])._admit(tiny)
+    assert d == REFUSED and "budget" in reason
+    # demand beyond the ladder's reach: REFUSED without spill...
+    big = Job(key="2pc-big",
+              build=_build_2pc(3, target_states=10_000_000),
+              capacity=1 << 12, batch=256)
+    monkeypatch.setenv("STATERIGHT_TPU_DEVICE_BYTES", "30000000")
+    d, reason, _b = _sched([big])._admit(big)
+    assert d == REFUSED and "demand" in reason
+    # ...and routed to the host tier with spill enabled
+    d, reason, _b = _sched([big], spill=True)._admit(big)
+    assert d == ADMITTED_SPILL and "spill" in reason.lower()
+
+
+def test_twin_less_job_runs_the_host_engine():
+    """A REAL model with no tensor twin is served by the host BFS
+    engine in its slot (unsupervised, the packed-cohort rule) — never
+    spawned on the device engine it cannot run on."""
+    from stateright_tpu.core import Model, Property
+
+    class Ring(Model):
+        n = 4
+
+        def init_states(self):
+            return [0]
+
+        def actions(self, state):
+            return [("tick",)]
+
+        def next_state(self, state, action):
+            return (state + 1) % self.n
+
+        def properties(self):
+            return [
+                Property.sometimes("wrapped", lambda m, s: s == self.n - 1)
+            ]
+
+    sched = _sched([Job(key="ring", build=lambda: Ring().checker())])
+    res = sched.run()
+    r = res["ring"]
+    assert r.status == COMPLETED and r.decision == ADMITTED
+    assert "host-side" in r.reason
+    assert r.unique == 4 and r.discoveries == ["wrapped"]
+    assert res.engine_compiles == 0  # nothing compiled for the device
+
+
+# -- cohort packing ----------------------------------------------------------
+
+
+def test_pack_groups_same_shape_admitted_jobs():
+    jobs = [
+        Job(key="a", build=_build_2pc(3), packable=True),
+        Job(key="b", build=_build_2pc(3), packable=True),
+        Job(key="c", build=_build_2pc(4), packable=True),  # other shape
+        Job(key="d", build=_build_2pc(3), packable=False),  # opted out
+    ]
+    packed, leftover = _sched(jobs, slots=2)._pack(
+        [(j, ADMITTED, None) for j in jobs]
+    )
+    assert len(packed) == 1
+    members, cohort_id = packed[0]
+    assert sorted(j.key for j in members) == ["a", "b"]
+    assert cohort_id.startswith("pack-")
+    # the different-shape and opted-out jobs fall back to singletons
+    assert sorted(j.key for j, _d, _r in leftover) == ["c", "d"]
+
+
+def test_pack_disabled_spilled_or_unsignable_yields_singletons():
+    jobs = [_job("a", packable=True), _job("b", packable=True)]
+    admitted = [(j, ADMITTED, None) for j in jobs]
+    # pack=False: nobody packs
+    packed, leftover = _sched(jobs, slots=1, pack=False)._pack(admitted)
+    assert packed == [] and len(leftover) == 2
+    # pack=True but twin-less fakes cannot shape-sign: loud singleton
+    # fallback (reason pack_fallback), never a crash
+    packed, leftover = _sched(jobs, slots=1)._pack(admitted)
+    assert packed == []
+    assert [r for _j, _d, r in leftover] == ["pack_fallback"] * 2
+    # spill-admitted jobs never pack (the sweep engine rejects spill)
+    real = [
+        Job(key="a", build=_build_2pc(3), packable=True),
+        Job(key="b", build=_build_2pc(3), packable=True),
+    ]
+    packed, leftover = _sched(real, slots=1)._pack(
+        [(j, ADMITTED_SPILL, "spilled") for j in real]
+    )
+    assert packed == [] and len(leftover) == 2
+
+
+# -- scheduling, priorities, records -----------------------------------------
+
+
+def test_fleet_runs_jobs_and_respects_priority(tmp_path):
+    order = []
+
+    def tracked(key):
+        b = FakeBuilder(unique=3, states=5, depth=1)
+        real = b.spawn_tpu
+
+        def spy(resume=None, **kw):
+            order.append(key)
+            return real(resume=resume, **kw)
+
+        b.spawn_tpu = spy
+        return lambda: b
+
+    jobs = [
+        Job(key="low", build=tracked("low"), priority=0),
+        Job(key="high", build=tracked("high"), priority=9),
+        Job(key="mid", build=tracked("mid"), priority=5),
+    ]
+    res = run_fleet(
+        FleetSpec(jobs=jobs, slots=1), root=str(tmp_path), stream=None
+    )
+    assert order == ["high", "mid", "low"]
+    assert res.completed == 3 and res.failed == 0 and res.refused == 0
+    assert all(r.status == COMPLETED for r in res.results.values())
+    # results read back in SPEC order regardless of run order
+    assert [r.key for r in res.results.values()] == ["low", "high", "mid"]
+    assert res["mid"].unique == 3 and res["mid"].states == 5
+
+
+def test_fleet_job_failure_is_a_ledger_row_not_a_crash(tmp_path):
+    boom = FakeBuilder(
+        spawn_plan={0: {"fail": RuntimeError("device on fire")}}
+    )
+    jobs = [_job("bad", builder=boom), _job("good")]
+    res = run_fleet(
+        FleetSpec(jobs=jobs, slots=1, max_restarts=0),
+        root=str(tmp_path), stream=None,
+    )
+    assert res.failed == 1 and res.completed == 1
+    assert res["bad"].status == FAILED
+    assert "device on fire" in (res["bad"].reason or "")
+    assert res["good"].status == COMPLETED
+
+
+def test_fleet_refused_job_never_spawns(tmp_path, monkeypatch):
+    monkeypatch.setenv("STATERIGHT_TPU_DEVICE_BYTES", "1000000")
+    huge = Job(key="huge", build=_build_2pc(3), capacity=1 << 20,
+               batch=1024)
+    res = run_fleet(
+        FleetSpec(jobs=[huge, _job("ok")], slots=1),
+        root=str(tmp_path), stream=None,
+    )
+    assert res.refused == 1 and res.completed == 1
+    assert res["huge"].status == REFUSED and res["huge"].run_id is None
+    assert res["huge"].reason and "budget" in res["huge"].reason
+
+
+def test_injected_stall_preempts_requeues_and_resumes(tmp_path):
+    """The chaos cycle with fakes: the victim blocks on the only slot,
+    the in-band injection (armed at spawn) forces a stall record on its
+    third step, the monitor yields it, the waiting job drains FIRST
+    (the re-queue landed the victim behind equal-priority work — that
+    is what the yield bought), then the victim resumes and completes —
+    with the submit/place/preempt/resume/done trail on the fleet
+    recorder."""
+    recs = []
+
+    def rf():
+        r = FlightRecorder(capacity=256)
+        recs.append(r)
+        return r
+
+    victim = FakeBuilder(unique=7, states=9, depth=2,
+                         recorder_factory=rf,
+                         spawn_plan={0: {"block": True}})
+    other = FakeBuilder(unique=1, states=2, depth=1)
+    jobs = [
+        Job(key="victim", build=lambda: victim),
+        Job(key="other", build=lambda: other),
+    ]
+    rec = FlightRecorder(capacity=1024)
+    sched = FleetScheduler(
+        FleetSpec(jobs=jobs, slots=1), root=str(tmp_path),
+        recorder=rec, preemption=PreemptionPlan({"victim": 3}),
+        stream=None,
+    )
+    stop_driving = threading.Event()
+
+    def drive():
+        # emit step records on the victim's recorder until the injected
+        # stall lands (the in-band seam fires on the crossing step)
+        deadline = time.monotonic() + 10.0
+        n = 0
+        while not stop_driving.is_set() and time.monotonic() < deadline:
+            if recs:
+                n += 1
+                recs[0].step(engine="fake", states=n, unique=n)
+                if any(
+                    h.get("reason") == "injected"
+                    for h in recs[0].records("health")
+                ):
+                    return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    try:
+        res = sched.run()
+    finally:
+        stop_driving.set()
+        t.join(timeout=5)
+    assert res.preemptions == 1
+    v = res["victim"]
+    assert v.status == COMPLETED and v.preemptions == 1
+    assert v.unique == 7 and v.states == 9
+    assert res["other"].status == COMPLETED
+    # the trail: victim preempted, then the waiting job drained, then
+    # the victim resumed — the yield actually bought the slot
+    trail = [(r["key"], r["event"]) for r in rec.records("job")]
+    assert trail.index(("victim", "preempt")) \
+        < trail.index(("other", "done")) \
+        < trail.index(("victim", "resume")) \
+        < trail.index(("victim", "done"))
+    # two spawns: the preempted attempt and the resume
+    assert len(victim.spawn_log) == 2
+    # pool snapshot reconciles on the shared recorder
+    snap = rec.fleet()
+    assert snap["v"] == FLEET_V and snap["completed"] == 2
+    assert snap["preemptions"] == 1 and snap["running"] == []
+
+
+def test_fleet_result_json_and_metrics_view(tmp_path):
+    res = run_fleet(
+        FleetSpec(jobs=[_job("a"), _job("b")], slots=2),
+        root=str(tmp_path), stream=None,
+    )
+    doc = res.to_json()
+    assert doc["v"] == FLEET_V and doc["completed"] == 2
+    assert len(doc["jobs"]) == 2
+    json.dumps(doc)  # JSON-serializable end to end
+    # the Explorer pool panel reads the fleet block off /.metrics
+    from stateright_tpu.explorer import _metrics_view
+
+    class Host:
+        flight_recorder = res.recorder
+
+    view = _metrics_view(Host())
+    assert view["fleet"]["slots"] == 2
+    assert view["fleet"]["completed"] == 2
+
+
+# -- campaigns ---------------------------------------------------------------
+
+
+def test_expand_grid_cross_product_and_validation():
+    pts = expand_grid({"b": [1, 2], "a": ["x"]})
+    assert pts == [{"a": "x", "b": 1}, {"a": "x", "b": 2}]
+    assert expand_grid({}) == [{}]
+    assert expand_grid({"a": 3}) == [{"a": 3}]  # scalars auto-wrap
+    with pytest.raises(ValueError):
+        expand_grid({"a": []})
+
+
+def test_campaign_spec_maps_grid_points_to_jobs():
+    spec = campaign_spec(
+        lambda n=3: FakeModel(), {"n": [3, 4]},
+        campaign_id="c-test", priority_fn=lambda p: p["n"],
+    )
+    assert spec.campaign_id == "c-test"
+    assert [j.key for j in spec.jobs] == ["n=3", "n=4"]
+    assert [j.priority for j in spec.jobs] == [3, 4]
+    assert all(j.packable for j in spec.jobs)
+    assert spec.jobs[0].params == {"n": 3}
+    # an omitted campaign_id still mints one (the grouping tag)
+    anon = campaign_spec(lambda n=3: FakeModel(), {"n": [3]})
+    assert anon.campaign_id
+
+
+class _CampaignModel(FakeModel):
+    """A fake model whose ``.checker()`` yields a FakeBuilder — the
+    campaign build path prefers a model-provided checker factory."""
+
+    def __init__(self, n):
+        self.n = int(n)
+
+    def checker(self):
+        return FakeBuilder(unique=self.n, states=2 * self.n, depth=1)
+
+
+def test_run_campaign_writes_the_ledger(tmp_path):
+    spec = campaign_spec(_CampaignModel, {"n": [3, 5]},
+                         campaign_id="c-led")
+    res, ledger = run_campaign(spec, root=str(tmp_path), stream=None)
+    assert res.completed == 2
+    assert ledger["v"] == FLEET_V and ledger["campaign_id"] == "c-led"
+    assert ledger["completed"] == 2 and ledger["failed"] == 0
+    assert ledger["total_states"] == 6 + 10
+    assert {r["key"] for r in ledger["results"]} == {"n=3", "n=5"}
+    on_disk = json.loads((tmp_path / LEDGER_NAME).read_text())
+    assert on_disk == ledger
+    assert build_ledger(spec, res)["total_states"] == 16
+
+
+def test_run_campaign_ledger_write_failure_degrades_loudly(tmp_path):
+    target = tmp_path / "blocked"
+    target.write_text("a file where the campaign root must go")
+    spec = campaign_spec(_CampaignModel, {"n": [1]})
+    err = io.StringIO()
+    res, ledger = run_campaign(spec, root=str(target), stream=err)
+    assert res.completed == 1  # the answer survives the artifact
+    assert ledger["completed"] == 1
+    assert "ledger write failed" in err.getvalue()
+
+
+# -- CLI surfaces ------------------------------------------------------------
+
+
+def test_pop_fleet_opts_parses_shared_flags():
+    from stateright_tpu.models._cli import _pop_fleet_opts
+
+    opts, rest = _pop_fleet_opts(
+        ["--slots=4", "--budget=1000", "--spill", "--no-pack",
+         "--root=/r", "--runs=/q", "--every=0.5", "--stall=k@7",
+         "--max-restarts=1", "--id=cid", "--grid={\"a\":[1]}",
+         "positional"],
+        {"slots": 2, "budget": None, "spill": False, "pack": True,
+         "root": None, "runs": None, "every": 0.0, "stall": None,
+         "max_restarts": 2, "id": None, "grid": None},
+    )
+    assert opts["slots"] == 4 and opts["budget"] == 1000
+    assert opts["spill"] is True and opts["pack"] is False
+    assert opts["root"] == "/r" and opts["runs"] == "/q"
+    assert opts["every"] == 0.5 and opts["stall"] == "k@7"
+    assert opts["max_restarts"] == 1 and opts["id"] == "cid"
+    assert json.loads(opts["grid"]) == {"a": [1]}
+    assert rest == ["positional"]
+
+
+def test_campaign_verb_rejects_unknown_factory():
+    from stateright_tpu.models._cli import fleet_campaign
+
+    out = io.StringIO()
+    assert fleet_campaign(["nope"], stream=out) == 2
+    assert "usage: campaign" in out.getvalue()
+
+
+def test_runs_verb_groups_campaign_jobs(tmp_path):
+    from stateright_tpu.models._cli import fleet_runs
+
+    reg = tmp_path / "runs"
+    reg.mkdir()
+    recs = [
+        {"v": 1, "run_id": f"r{i}", "config_key": "cfg",
+         "model": "M", "engine": "wavefront",
+         "campaign_id": "camp-1", "job_key": f"job-{i}",
+         "headline": {"unique": 10 + i, "done": True,
+                      "discoveries": ["p"] if i else []},
+         "generated_at": "2026-08-07T00:00:00+00:00"}
+        for i in range(2)
+    ]
+    with open(reg / "index.jsonl", "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    out = io.StringIO()
+    assert fleet_runs([str(reg)], stream=out) == 0
+    text = out.getvalue()
+    assert "campaign camp-1  2 job(s)  verdicts [.*]" in text
+    assert "[job-0]" in text and "[job-1]" in text
+
+
+# -- zero-coupling contract --------------------------------------------------
+
+
+def test_engine_modules_never_import_the_fleet():
+    """Fleet off ⇒ zero coupling: no engine/checker/sweep/telemetry
+    module may import stateright_tpu.fleet (the scheduler calls INTO
+    the engines, never the reverse), so a fleet-less run's jaxprs and
+    cache keys cannot change by construction."""
+    import stateright_tpu
+
+    root = os.path.dirname(stateright_tpu.__file__)
+    offenders = []
+    for sub in ("parallel", "checker", "sweep", "telemetry", "spill",
+                "ops"):
+        for dirpath, _dirs, files in os.walk(os.path.join(root, sub)):
+            for name in files:
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path) as f:
+                    src = f.read()
+                for ln in src.splitlines():
+                    stmt = ln.strip().split("#")[0]
+                    if not (stmt.startswith("import ")
+                            or stmt.startswith("from ")):
+                        continue
+                    if "fleet" in stmt:
+                        offenders.append(
+                            f"{os.path.relpath(path, root)}: {stmt}"
+                        )
+    assert not offenders, (
+        f"engine modules import the fleet subsystem: {offenders}"
+    )
+
+
+def test_supervisor_yield_event_is_optional(tmp_path):
+    """The cooperative-yield hook must be pay-for-use: supervise()
+    without yield_event completes exactly as before PR 17 (the PR 13
+    surface is unchanged for existing callers)."""
+    from stateright_tpu.supervisor import supervise
+
+    b = FakeBuilder(unique=4, states=6, depth=1)
+    run = supervise(b, autosave_dir=str(tmp_path), every_secs=60)
+    assert run.yielded is False
+    assert run.unique_state_count() == 4
+
+
+# -- medium tier: real-engine acceptance -------------------------------------
+
+
+@pytest.mark.medium
+def test_fleet_acceptance_packs_and_matches_solo_counts(tmp_path):
+    """The N-job acceptance (docs/fleet.md): three packable 2pc-3
+    tenants + a 2pc-4 singleton over a 2-slot pool.  Every count must
+    be bit-identical to the solo pins, the three same-shape jobs must
+    share ONE cohort engine compile (compile accounting strictly below
+    the job count), and the registry must group every member under the
+    campaign tag."""
+    runs = str(tmp_path / "runs")
+
+    def job(key, n, packable, cap):
+        return Job(key=key, build=_build_2pc(n, runs=runs),
+                   packable=packable, capacity=cap, batch=256)
+
+    jobs = [
+        job("2pc3-a", 3, True, 1 << 12),
+        job("2pc3-b", 3, True, 1 << 12),
+        job("2pc3-c", 3, True, 1 << 12),
+        job("2pc4", 4, False, 1 << 13),
+    ]
+    res = run_fleet(
+        FleetSpec(jobs=jobs, slots=2, campaign_id="camp-accept"),
+        root=str(tmp_path / "fleet"), stream=None,
+    )
+    assert res.completed == 4 and res.failed == 0 and res.refused == 0
+    for k in ("2pc3-a", "2pc3-b", "2pc3-c"):
+        assert (res[k].unique, res[k].states) == (288, 1146), k
+        assert res[k].cohort  # rode a packed cohort
+    assert (res["2pc4"].unique, res["2pc4"].states) == (1568, 8258)
+    assert res["2pc4"].cohort is None
+    # compile amortization: 1 cohort compile + 1 singleton compile
+    assert res.engine_compiles < len(jobs)
+    assert res.engine_compiles == 2
+    assert sum(len(p["jobs"]) for p in res.packed) == 3
+    # every job archived under the campaign tag (packed members too)
+    from stateright_tpu.telemetry.registry import RunRegistry
+
+    idx = RunRegistry(runs).index()
+    tagged = [r for r in idx if r.get("campaign_id") == "camp-accept"]
+    assert {r.get("job_key") for r in tagged} == {
+        "2pc3-a", "2pc3-b", "2pc3-c", "2pc4",
+    }
+
+
+@pytest.mark.medium
+def test_fleet_acceptance_preempt_resume_exactly_once(tmp_path):
+    """The exactly-once acceptance (docs/fleet.md): an injected stall
+    preempts the victim mid-run (snapshot + yield), the victim resumes
+    from its final autosave generation, and the parent/child report
+    pair classifies IDENTICAL under the lineage contract — same final
+    counts as an uninterrupted run."""
+    from stateright_tpu.models._cli import compare_reports_cmd
+
+    runs = str(tmp_path / "runs")
+    jobs = [
+        Job(key="victim", build=_build_2pc(4, runs=runs),
+            capacity=1 << 13, batch=256),
+        Job(key="other", build=_build_2pc(3, runs=runs),
+            capacity=1 << 12, batch=256),
+    ]
+    res = run_fleet(
+        FleetSpec(jobs=jobs, slots=1),
+        root=str(tmp_path / "fleet"),
+        preemption=PreemptionPlan({"victim": 2}),
+        every_secs=0.2, stream=None,
+    )
+    assert res.completed == 2 and res.preemptions == 1
+    v = res["victim"]
+    assert v.status == COMPLETED and v.preemptions == 1
+    # exactly-once: the solo pin, not a partial and not a double-count
+    assert (v.unique, v.states) == (1568, 8258)
+    assert v.parent_run_id and v.run_id
+    assert (res["other"].unique, res["other"].states) == (288, 1146)
+    out = io.StringIO()
+    rc = compare_reports_cmd(
+        [v.parent_run_id, v.run_id, f"--registry={runs}",
+         "--expect=IDENTICAL"],
+        out,
+    )
+    assert rc == 0, out.getvalue()
+    assert "IDENTICAL (contract: lineage)" in out.getvalue()

@@ -58,7 +58,7 @@ def crawl_and_check(m, tm, max_levels=None):
     return seen
 
 
-@pytest.mark.medium
+@pytest.mark.slow
 def test_paxos1_full_equivalence():
     m = paxos_model(1, 3)
     tm = m.tensor_model()

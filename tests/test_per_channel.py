@@ -74,6 +74,7 @@ def per_channel(m):
 # -- encoding parity ----------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_paxos1_stepwise_parity_and_roundtrip():
     """The strongest parity form: per state of the ENTIRE paxos-1 space,
     the per-channel twin's device successors equal the object model's,
@@ -146,6 +147,7 @@ def test_engine_parity_duplicating_actor_2pc():
     assert (h.state_count(), h.unique_state_count()) == (793, 279)
 
 
+@pytest.mark.slow
 def test_engine_parity_register_history_twins():
     """History-carrying register workloads: the multi-op codec
     (put_count=2) and the write-once wfail path."""
@@ -164,7 +166,7 @@ def test_engine_parity_register_history_twins():
     assert a == b == (97, 71, ["value chosen"])
 
 
-@pytest.mark.medium
+@pytest.mark.slow
 def test_engine_parity_lossy_variants():
     """Lossy networks across two semantics: ordered paxos (drop advances
     the flow) and the duplicating actor-2pc (drop is permanent)."""
@@ -185,7 +187,7 @@ def test_engine_parity_lossy_variants():
     assert (a[0], a[1]) == (58_305, 11_392)
 
 
-@pytest.mark.medium
+@pytest.mark.slow
 def test_engine_parity_raft_timers_and_symmetry_composition():
     """The general fragment with timers, plus the symmetry()+prededup()
     composition (the PR-6 slow-tier pattern)."""

@@ -711,3 +711,83 @@ def test_diff_section_gates_fresh_runs_only(tmp_path, capsys):
     rc, v = run({"fresh": False, "tpu_paxos3_report": drifted},
                 "--diff", "--allow-stale")
     assert rc == 0 and v["diff"]["verdict"] == "DIVERGENT"
+
+
+def test_fleet_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--fleet: the multi-tenant scheduler leg (docs/fleet.md).
+    Flag-gated like --spill/--mxu/--sweep: absence (stale artifacts,
+    pre-fleet baselines) never trips; a present-but-crashed,
+    parity-breaking, incomplete, malformed, or unamortized leg trips
+    fresh runs only."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # pre-fleet: no tpu_fleet
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    blk = {
+        "jobs": 4, "slots": 2, "completed": 4, "preemptions": 0,
+        "engine_compiles": 2, "sequential_engine_compiles": 4,
+        "packed": 3, "states": 11696, "sec": 6.0,
+        "sequential_sec": 14.0, "parity": "IDENTICAL",
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_fleet": blk}
+    # absence never trips (pre-fleet artifacts pass untouched)
+    rc, v = run({"fresh": True,
+                 "tpu_paxos3_states_per_sec": 270000.0}, "--fleet")
+    assert rc == 0 and v["fleet"]["ok"] is True
+    assert v["fleet"]["present"] is False
+    assert v["fleet"]["baseline_present"] is False
+    # a well-formed leg passes and reports the amortization
+    rc, v = run(good, "--fleet")
+    assert rc == 0 and v["fleet"]["ok"] is True
+    assert v["fleet"]["amortization"]["engine_compiles"] == 2
+    # a crashed leg trips
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+                 "tpu_fleet_error": "AssertionError: drift"}, "--fleet")
+    assert rc == 1 and v["fleet"]["ok"] is False
+    # parity drift trips
+    bad = json.loads(json.dumps(blk))
+    bad["parity"] = "DRIFT"
+    rc, v = run({**good, "tpu_fleet": bad}, "--fleet")
+    assert rc == 1 and any(
+        "parity" in p for p in v["fleet"]["problems"]
+    )
+    # an unfinished tenant trips (completed != jobs)
+    bad = json.loads(json.dumps(blk))
+    bad["completed"] = 3
+    rc, v = run({**good, "tpu_fleet": bad}, "--fleet")
+    assert rc == 1 and any(
+        "completed" in p for p in v["fleet"]["problems"]
+    )
+    # packed cohorts without compile amortization trip
+    bad = json.loads(json.dumps(blk))
+    bad["engine_compiles"] = 4
+    rc, v = run({**good, "tpu_fleet": bad}, "--fleet")
+    assert rc == 1 and any(
+        "amortization" in p for p in v["fleet"]["problems"]
+    )
+    # an unpacked fleet owes no amortization
+    solo = json.loads(json.dumps(blk))
+    solo["packed"] = 0
+    solo["engine_compiles"] = 4
+    rc, v = run({**good, "tpu_fleet": solo}, "--fleet")
+    assert rc == 0 and v["fleet"]["ok"] is True
+    # malformed/corrupt blocks produce a verdict, not a crash
+    for garbage in ("nope", {"jobs": "x"}, {"preemptions": -1}):
+        rc, v = run({**good, "tpu_fleet": garbage}, "--fleet")
+        assert rc == 1 and v["fleet"]["ok"] is False
+    # stale artifacts still exit 2; --allow-stale reports without gating
+    rc, v = run({"fresh": False, "tpu_fleet": blk}, "--fleet")
+    assert rc == 2
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0,
+                 "tpu_fleet": blk},
+                "--fleet", "--allow-stale")
+    assert rc == 0

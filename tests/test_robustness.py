@@ -696,3 +696,59 @@ def test_killed_parent_gets_stub_archived_and_lineage_gate_passes(
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "lineage" in out
+
+
+# -- cooperative yield (the fleet scheduler's preemption hook) ---------------
+
+
+def test_supervise_yield_event_stops_and_marks_partial(tmp_path):
+    """A pre-set ``yield_event`` asks the engine to stop at its next
+    host sync: ``supervise`` returns ``yielded=True`` WITHOUT burning a
+    restart, and a fresh ``supervise`` on the same dir continues the
+    work (with fakes: the scripted second attempt completes)."""
+    import threading
+
+    from tests.fleet_fakes import FakeBuilder
+
+    b = FakeBuilder(unique=5, states=8, depth=1,
+                    spawn_plan={0: {"block": True}})
+    ev = threading.Event()
+    ev.set()
+    run = supervise(b, autosave_dir=str(tmp_path), every_secs=60,
+                    yield_event=ev)
+    assert run.yielded is True
+    assert run.restarts == 0  # a yield is not a failure
+    resumed = supervise(b, autosave_dir=str(tmp_path), every_secs=60)
+    assert resumed.yielded is False
+    assert resumed.unique_state_count() == 5
+    assert len(b.spawn_log) == 2
+
+
+@pytest.mark.medium
+def test_supervise_yielded_2pc4_resumes_bit_identical(tmp_path):
+    """The yield/resume contract on a REAL engine (docs/fleet.md
+    "Preemption"): a yielded run leaves a resumable final autosave
+    generation, and re-supervising the same dir finishes with counts
+    bit-identical to an uninterrupted run, linked by lineage."""
+    import threading
+
+    d = str(tmp_path / "auto")
+    ev = threading.Event()
+    ev.set()  # yield at the very first opportunity
+    part = supervise(
+        TwoPhaseSys(4).checker().telemetry(),
+        autosave_dir=d, every_secs=0.0, yield_event=ev,
+        batch=64, steps_per_call=2,
+    )
+    assert part.yielded is True
+    assert ckpt.latest_gen_number(d) is not None  # resume point exists
+    assert part.unique_state_count() < 1568  # genuinely partial
+    done = supervise(
+        TwoPhaseSys(4).checker().telemetry(),
+        autosave_dir=d, every_secs=0.0,
+        batch=64, steps_per_call=2,
+    )
+    assert done.yielded is False
+    assert done.unique_state_count() == 1568
+    assert done.state_count() == 8258
+    assert done.checker.parent_run_id == part.checker.run_id

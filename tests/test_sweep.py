@@ -234,6 +234,7 @@ def test_seed_sweep_shares_one_program_and_reconciles():
         ]
 
 
+@pytest.mark.slow
 def test_paxos1_hand_twin_member_parity():
     spec = SweepSpec([
         SweepInstance("2pc", TwoPhaseSys(3)),
@@ -482,7 +483,7 @@ def test_runs_verb_groups_sweep_members(tmp_path):
 # -- the mixed-family crawl (lossy/non-lossy paxos + 2pc) --------------------
 
 
-@pytest.mark.medium
+@pytest.mark.slow
 def test_mixed_lossiness_sweep_full_parity():
     """The ISSUE's sweep: 2pc + lossy/non-lossy paxos-1 (hand twin +
     compiled twins, three shape cohorts), every instance reconciling

@@ -389,8 +389,8 @@ def test_explorer_metrics_endpoint_shape():
     try:
         m = _get(server.addr, "/.metrics")
         assert sorted(m) == [
-            "cartography", "counters", "durability", "health", "memory",
-            "occupancy", "roofline", "series", "spill", "summary",
+            "cartography", "counters", "durability", "fleet", "health",
+            "memory", "occupancy", "roofline", "series", "spill", "summary",
         ]
         series = m["series"]
         assert sorted(series) == [
@@ -409,6 +409,8 @@ def test_explorer_metrics_endpoint_shape():
         assert m["roofline"] is None
         # durability is null too: no autosave armed, no supervision trail
         assert m["durability"] is None
+        # fleet is null: the recorder belongs to no fleet scheduler
+        assert m["fleet"] is None
         # the health snapshot is always present with telemetry on
         assert m["health"]["phase"] == "done"
         assert m["health"]["stalled"] is False

@@ -133,6 +133,26 @@ SCHEMA = {
          "unified": bool, "key": str, "unique": int, "states": int,
          "depth": int, "cohorts": int, "engine_compiles": int},
     ),
+    "fleet": (
+        # fleet-scheduler pool bookkeeping (stateright_tpu/fleet/,
+        # docs/fleet.md): start (pool opens) and done (pool drained,
+        # with the terminal tallies + compile accounting)
+        {"v": int, "event": str, "slots": int, "jobs": int},
+        {"completed": int, "failed": int, "refused": int,
+         "preemptions": int, "engine_compiles": int, "packed": int},
+    ),
+    "job": (
+        # per-tenant lifecycle (stateright_tpu/fleet/, docs/fleet.md):
+        # submit -> place (admission decision) -> [pack] -> [preempt ->
+        # resume]* -> done; gen is the autosave generation a preempted
+        # job yields at / resumes from, run_id/parent_run_id the
+        # registry lineage the exactly-once gate walks
+        {"v": int, "event": str, "key": str},
+        {"priority": int, "decision": str, "reason": str, "slot": int,
+         "cohort": str, "jobs": int, "gen": int, "status": str,
+         "unique": int, "states": int, "run_id": str,
+         "parent_run_id": str},
+    ),
     "memory": (
         # the HBM ledger's per-rung snapshot (telemetry/memory.py):
         # per-buffer analytic bytes + the growth-transient forecast;
@@ -347,6 +367,51 @@ def test_sweep_records_match_the_golden_schema(tmp_path):
     assert [
         (r["event"], r.get("key")) for r in rec2.records("sweep")
     ] == [(r["event"], r.get("key")) for r in sweeps]
+
+
+def test_fleet_records_match_the_golden_schema(tmp_path):
+    """A scheduled fleet emits the versioned ``fleet``/``job`` record
+    kinds (submit/place/preempt/resume/done + start/done), every record
+    validated field-by-field, and the export round-trips through
+    from_jsonl — without spawning a single engine (fake builders: the
+    schema is the scheduler's, not the engines')."""
+    from stateright_tpu.fleet import FleetSpec, Job, run_fleet
+    from stateright_tpu.telemetry import FlightRecorder
+    from tests.fleet_fakes import FakeBuilder
+
+    spec = FleetSpec(
+        jobs=[
+            Job(key="a", build=lambda: FakeBuilder(unique=7, states=9)),
+            Job(key="b", build=lambda: FakeBuilder(unique=3, states=4),
+                priority=1),
+        ],
+        slots=1,
+    )
+    res = run_fleet(spec, root=str(tmp_path / "fleet"))
+    path = tmp_path / "export.jsonl"
+    res.recorder.to_jsonl(path)
+    lines = [json.loads(ln) for ln in path.read_text().splitlines() if ln]
+    records = [ln for ln in lines if ln.get("kind") != "header"]
+    fleet = [r for r in records if r["kind"] == "fleet"]
+    jobs = [r for r in records if r["kind"] == "job"]
+    assert [r["event"] for r in fleet] == ["start", "done"]
+    events = [(r["event"], r["key"]) for r in jobs]
+    for key in ("a", "b"):
+        for ev in ("submit", "place", "done"):
+            assert (ev, key) in events, f"missing {ev}/{key}"
+    problems = []
+    for r in records:
+        problems += _check_record(r)
+    assert not problems, "\n".join(problems)
+    # the summary carries the final pool snapshot alongside the others
+    assert lines[0]["summary"]["fleet"]["slots"] == 1
+    # round-trip: the restored ring carries the same job records AND
+    # the reconciled pool snapshot
+    rec2 = FlightRecorder.from_jsonl(path)
+    assert [
+        (r["event"], r["key"]) for r in rec2.records("job")
+    ] == events
+    assert rec2.fleet() == lines[0]["summary"]["fleet"]
 
 
 def test_summary_cartography_block_matches_snapshot_schema(tmp_path):
