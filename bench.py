@@ -1362,6 +1362,64 @@ def tpu_phase() -> dict:
             out["tpu_fleet_error"] = f"{type(e).__name__}: {e}"
         _persist(out)
 
+    # flag-gated MESH leg (BENCH_MESH=1; docs/mesh.md): the GSPMD
+    # mesh engine vs the single-device wavefront on the same 2pc
+    # instance.  Count parity vs the solo run is ASSERTED (a
+    # partitioning that drifts cannot report a win), and the block
+    # carries the per-shard load vector, the imbalance summary, and the
+    # routed-state total NEXT TO the throughput pair — GPUexplore's
+    # scalability study names routing imbalance as what breaks at
+    # scale, so the A/B ships with its own scalability readout.
+    if os.environ.get("BENCH_MESH", "") == "1":
+        try:
+            from stateright_tpu.checker.base import CheckerBuilder
+            from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+            n_me = int(os.environ.get("BENCH_MESH_RMS", "5") or 5)
+
+            def build_me():
+                return CheckerBuilder(TwoPhaseSys(n_me)).spawn_tpu(
+                    sync=True, capacity=1 << 15, batch=256,
+                )
+
+            _mark("mesh leg (mesh run)")
+            t_me = time.monotonic()
+            cm = CheckerBuilder(TwoPhaseSys(n_me)).mesh().spawn_tpu(
+                sync=True, capacity=1 << 15, batch=256,
+            )
+            dt_me = time.monotonic() - t_me
+            _mark("mesh leg (solo oracle)")
+            t_ms = time.monotonic()
+            cs = build_me()
+            dt_ms = time.monotonic() - t_ms
+            pair_m = (cm.unique_state_count(), cm.state_count())
+            pair_s = (cs.unique_state_count(), cs.state_count())
+            if pair_m != pair_s:
+                raise AssertionError(
+                    f"mesh-vs-solo count drift: {pair_m} != {pair_s}"
+                )
+            stats_me = cm.mesh_stats()
+            out["tpu_mesh_states_per_sec"] = round(pair_m[1] / dt_me, 1)
+            out["tpu_mesh_solo_states_per_sec"] = round(
+                pair_s[1] / dt_ms, 1
+            )
+            out["tpu_mesh"] = {
+                "model": f"2pc-{n_me}",
+                "devices": int(stats_me["devices"]),
+                "unique": int(pair_m[0]),
+                "states": int(pair_m[1]),
+                "shard_load": stats_me["shard_load"],
+                "imbalance": stats_me["imbalance"],
+                "routed_states": int(stats_me["routed_states"]),
+                "sec": round(dt_me, 3),
+                "solo_sec": round(dt_ms, 3),
+                "parity": "IDENTICAL",
+            }
+            _mark("mesh leg done")
+        except Exception as e:  # noqa: BLE001 - same never-void rule
+            out["tpu_mesh_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
     # reference bench protocol on device.  All five configs compile — the
     # actor compiler gained ordered-FIFO network support in round 2
     # (parallel/actor_compiler.py), so lin-reg-3-ordered runs on device too

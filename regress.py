@@ -9,7 +9,7 @@ staleness and regressions LOUD:
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
                       [--memory] [--spill] [--roofline] [--mxu]
-                      [--sweep] [--fleet] [--diff]
+                      [--sweep] [--fleet] [--mesh] [--diff]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -803,6 +803,99 @@ def fleet_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+def mesh_verdict(run: dict, baseline: dict) -> dict:
+    """``--mesh``: the GSPMD mesh-engine leg (docs/mesh.md).
+
+    The leg is FLAG-gated (``BENCH_MESH=1``), so absence never trips —
+    stale artifacts and pre-mesh baselines pass untouched (the
+    spill/mxu/sweep/fleet rule; unit-tested with injected artifacts).
+    When a fresh run carries it:
+
+     - a crashed leg (``tpu_mesh_error``) is a gate failure, not a
+       skip;
+     - the block must be WELL-FORMED: positive device/unique/state
+       counts with ``states >= unique``;
+     - count parity must have held (``parity == "IDENTICAL"`` — the leg
+       asserts unique/total equality against a solo single-device
+       wavefront oracle of the same model; a partitioning that drifts
+       cannot report a win);
+     - the imbalance readout must be sound: ``shard_load`` is a
+       per-device vector of non-negative ints summing to ``unique``
+       (the partition rules place every visited row on exactly one
+       shard owner) and ``routed_states`` is an int strictly below
+       ``unique`` (init states appear in the load but route nowhere).
+    """
+    out: dict = {}
+    problems = []
+    err = run.get("tpu_mesh_error")
+    blk = run.get("tpu_mesh")
+    present = bool(err) or blk is not None
+    if err:
+        problems.append(f"leg crashed: tpu_mesh: {err}")
+    if blk is not None and not isinstance(blk, dict):
+        problems.append("tpu_mesh block is not an object")
+        blk = None
+    if isinstance(blk, dict):
+        ints = {}
+        for k in ("devices", "unique", "states"):
+            v = blk.get(k)
+            if not isinstance(v, int) or v <= 0:
+                problems.append(f"tpu_mesh.{k} missing/malformed: {v!r}")
+            else:
+                ints[k] = v
+        if (
+            {"unique", "states"} <= set(ints)
+            and ints["states"] < ints["unique"]
+        ):
+            problems.append(
+                f"tpu_mesh.states={ints['states']} < "
+                f"unique={ints['unique']} (total visits bound uniques)"
+            )
+        if blk.get("parity") != "IDENTICAL":
+            problems.append(
+                f"tpu_mesh.parity={blk.get('parity')!r} (mesh counts "
+                "must reconcile IDENTICAL against the solo wavefront "
+                "oracle)"
+            )
+        load = blk.get("shard_load")
+        if (
+            not isinstance(load, list)
+            or not load
+            or any(not isinstance(v, int) or v < 0 for v in load)
+            or ("devices" in ints and len(load) != ints["devices"])
+        ):
+            problems.append(
+                f"tpu_mesh.shard_load missing/malformed: {load!r} "
+                "(one non-negative entry per mesh device)"
+            )
+        elif "unique" in ints and sum(load) != ints["unique"]:
+            problems.append(
+                f"tpu_mesh.shard_load sums to {sum(load)} != "
+                f"unique={ints['unique']} (every visited row has exactly "
+                "one shard owner)"
+            )
+        else:
+            out["shard_load"] = load
+            imb = blk.get("imbalance")
+            ratio = imb.get("ratio") if isinstance(imb, dict) else None
+            if isinstance(ratio, (int, float)):
+                out["imbalance_ratio"] = ratio
+        routed = blk.get("routed_states")
+        if not isinstance(routed, int) or routed < 0 or (
+            "unique" in ints and routed >= ints["unique"]
+        ):
+            problems.append(
+                f"tpu_mesh.routed_states missing/malformed: {routed!r} "
+                "(init states route nowhere, so routed < unique)"
+            )
+    out["present"] = present
+    out["ok"] = not problems  # flag-gated: absence is not a failure
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_mesh"))
+    return out
+
+
 def diff_verdict(run: dict, baseline: dict) -> dict:
     """``--diff``: the contract-aware report diff
     (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
@@ -884,7 +977,7 @@ def main(argv=None, fleet=None) -> int:
     run_path, baseline_path = DEFAULT_RUN, DEFAULT_BASELINE
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
-    roofline = diff = mxu = sweep = fleet_gate = False
+    roofline = diff = mxu = sweep = fleet_gate = mesh_gate = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -913,6 +1006,8 @@ def main(argv=None, fleet=None) -> int:
             sweep = True
         elif a == "--fleet":
             fleet_gate = True
+        elif a == "--mesh":
+            mesh_gate = True
         elif a == "--diff":
             diff = True
         else:
@@ -998,6 +1093,14 @@ def main(argv=None, fleet=None) -> int:
         # spill/mxu/sweep rule)
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["fleet"]["ok"]
+    if mesh_gate:
+        verdict["mesh"] = mesh_verdict(run, baseline)
+        # flag-gated leg: absence passes; a present-but-crashed,
+        # parity-breaking, or load-vector-inconsistent leg trips fresh
+        # runs only (stale/pre-mesh baselines never trip — the
+        # spill/mxu/sweep/fleet rule)
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["mesh"]["ok"]
     if diff:
         verdict["diff"] = diff_verdict(run, baseline)
         # same freshness rule: stale artifacts and pre-registry
@@ -1126,6 +1229,19 @@ def main(argv=None, fleet=None) -> int:
             "compiles despite packing (tpu_fleet; see stdout JSON) — a "
             "scheduler that drifts or drops tenants is not a serving "
             "tier (docs/fleet.md)\n"
+        )
+        return 1
+    if (
+        "mesh" in verdict
+        and verdict["fresh"]
+        and not verdict["mesh"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the mesh leg is malformed, crashed, drifted its "
+            "counts, or carries an inconsistent shard-load/routing "
+            "readout (tpu_mesh; see stdout JSON) — a partitioned engine "
+            "that drifts or cannot account for its own placement is not "
+            "an A/B (docs/mesh.md)\n"
         )
         return 1
     if (

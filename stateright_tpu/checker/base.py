@@ -58,6 +58,10 @@ class CheckerBuilder:
         # docs/sweep.md); None = env default (STATERIGHT_TPU_SWEEP on
         # models that define sweep_family)
         self.sweep_spec = None
+        # mesh-native sharded engine (parallel/mesh.py, docs/mesh.md);
+        # None = env default (STATERIGHT_TPU_MESH, off when unset)
+        self.mesh_mode: Optional[bool] = None
+        self.mesh_devices: Optional[int] = None
 
     # -- configuration -------------------------------------------------------
 
@@ -405,6 +409,31 @@ class CheckerBuilder:
         }
         return self
 
+    def mesh(
+        self, enabled: bool = True, *, devices: Optional[int] = None
+    ) -> "CheckerBuilder":
+        """Run ``spawn_tpu`` on the mesh-native sharded engine
+        (``stateright_tpu/parallel/mesh.py``; docs/mesh.md): the
+        single-device wavefront program partitioned over a named
+        ``('host', 'chip')`` device mesh with ``NamedSharding`` rules —
+        visited table sharded by bucket owner, queue buffers sharded,
+        counters replicated — so the compiler inserts the cross-shard
+        collectives instead of a hand-scheduled ``shard_map`` body.
+
+        Parity contract, pinned by tests/test_mesh.py: unique/total
+        counts, property verdicts, discovery traces, and kill+resume
+        snapshots are bit-identical to the single-device wavefront
+        engine (the programs ARE the wavefront engine's; only placement
+        differs).  ``devices=N`` bounds the mesh to the first N local
+        devices (default: all of them).  Env override
+        ``STATERIGHT_TPU_MESH=1`` (or ``=N`` for a device bound).  The
+        OLD hand-rolled engine keeps its spelling — the
+        ``devices=``/``n_devices=``/``mesh=`` spawn kwargs — and wins
+        when both are given explicitly."""
+        self.mesh_mode = bool(enabled)
+        self.mesh_devices = int(devices) if devices is not None else None
+        return self
+
     def spill(self, enabled: bool = True) -> "CheckerBuilder":
         """Arm the billion-state spill tier on the wavefront engine
         (``stateright_tpu/spill/``; docs/spill.md): the visited set
@@ -699,13 +728,22 @@ class CheckerBuilder:
 
         Pass ``devices=N`` (or ``mesh=...``) to shard the wavefront over a
         device mesh with all-to-all fingerprint routing
-        (``stateright_tpu/parallel/sharded.py``).
+        (``stateright_tpu/parallel/sharded.py``).  The mesh-NATIVE engine
+        (``stateright_tpu/parallel/mesh.py``, docs/mesh.md) is spelled
+        :meth:`mesh` / ``--mesh`` / ``STATERIGHT_TPU_MESH=1`` instead;
+        an explicit ``devices``/``n_devices``/``mesh=`` argument keeps
+        selecting the old engine.
 
         A static preflight audit runs first (``docs/analysis.md``): audit
         errors abort here, before any device work; silence deliberately
         with :meth:`skip_audit`."""
+        from ..parallel.partition import resolve_mesh_flag
         from ..sweep import resolve_sweep_spec
 
+        mesh_on, mesh_n = resolve_mesh_flag(
+            getattr(self, "mesh_mode", None),
+            getattr(self, "mesh_devices", None),
+        )
         spec = resolve_sweep_spec(
             getattr(self, "sweep_spec", None), self.model
         )
@@ -714,6 +752,12 @@ class CheckerBuilder:
                 raise NotImplementedError(
                     "sweeps run on the single-device engine for now — "
                     "drop the devices/mesh argument (docs/sweep.md)"
+                )
+            if mesh_on:
+                raise NotImplementedError(
+                    "sweep x mesh is a queued unlock (ROADMAP): sweeps "
+                    "run on the single-device engine for now — drop "
+                    ".mesh()/--mesh/STATERIGHT_TPU_MESH (docs/sweep.md)"
                 )
             # audit once per distinct SHAPE of the family (the cohort
             # grouping key: twin class + row layout + properties) —
@@ -746,9 +790,16 @@ class CheckerBuilder:
         if devices is not None and devices != 1:
             kw.setdefault("n_devices", devices)
         if "n_devices" in kw or "mesh" in kw:
+            # the old engine's spelling stays the old engine — even with
+            # the mesh flag armed, an explicit devices/mesh argument is
+            # an explicit choice (the A/B harness relies on this)
             from ..parallel.sharded import ShardedTpuChecker
 
             return ShardedTpuChecker(self, **kw)
+        if mesh_on:
+            from ..parallel.mesh import MeshTpuChecker
+
+            return MeshTpuChecker(self, n_devices=mesh_n, **kw)
         from ..parallel.wavefront import TpuChecker
 
         return TpuChecker(self, **kw)

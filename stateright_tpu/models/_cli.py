@@ -92,7 +92,7 @@ def run_cli(
         if check_tpu is not None:
             print("  device verbs also take --checked, --prewarm, "
                   "--prededup, --por, --per-channel, --spill, --mxu, "
-                  "--compile-cache=DIR "
+                  "--mesh, --compile-cache=DIR "
                   "(docs/perf.md, docs/analysis.md, docs/spill.md, "
                   "docs/roofline.md) and "
                   "--watch (live status line, docs/telemetry.md)")
@@ -158,7 +158,7 @@ def pop_perf(rest: list) -> tuple:
     rest = list(rest)
     cfg = {"prewarm": False, "prededup": False, "compile_cache": None,
            "por": False, "spill": False, "per_channel": False,
-           "mxu": False}
+           "mxu": False, "mesh": False}
     kept = []
     for a in rest:
         if a == "--prewarm":
@@ -167,6 +167,8 @@ def pop_perf(rest: list) -> tuple:
             cfg["prededup"] = True
         elif a == "--mxu":
             cfg["mxu"] = True
+        elif a == "--mesh":
+            cfg["mesh"] = True
         elif a == "--por":
             cfg["por"] = True
         elif a == "--spill":
@@ -195,6 +197,8 @@ def apply_perf(builder, cfg: dict):
         builder = builder.spill()
     if cfg.get("mxu"):
         builder = builder.mxu()
+    if cfg.get("mesh"):
+        builder = builder.mesh()
     if cfg.get("compile_cache"):
         builder = builder.compile_cache(cfg["compile_cache"])
     return builder

@@ -22,7 +22,12 @@ from dataclasses import dataclass, replace
 from typing import Optional
 
 from .. import Model, Property
-from ..parallel.tensor_model import BitPacker, TensorBackedModel, TensorModel
+from ..parallel.tensor_model import (
+    BitPacker,
+    FieldWriter,
+    TensorBackedModel,
+    TensorModel,
+)
 from ..symmetry import RewritePlan
 from ._cli import (
     apply_encoding,
@@ -308,6 +313,20 @@ class TwoPhaseTensor(TensorModel):
     # -- device --------------------------------------------------------------
 
     def step_rows(self, rows):
+        return self._step_rows_impl(rows, coalesce=False)
+
+    def step_rows_coalesced(self, rows):
+        """Expand-scatter-coalesced step (``ops/mxu.py``, docs/roofline.md):
+        the same transition function with each action's packed-word
+        write-backs assembled as ONE word-stacked block (``FieldWriter``
+        coalesced mode) instead of one full-block slice read + scatter
+        per written field.  Successors and validity are bit-identical to
+        :meth:`step_rows` (whole-space parity pinned in tests); only the
+        assembly shape changes.  Selected by the engines under
+        ``CheckerBuilder.mxu()`` / ``--mxu``."""
+        return self._step_rows_impl(rows, coalesce=True)
+
+    def _step_rows_impl(self, rows, coalesce):
         import jax.numpy as jnp
 
         pk, n = self.packer, self.n
@@ -324,17 +343,22 @@ class TwoPhaseTensor(TensorModel):
 
         succs, valids = [], []
 
-        def emit(valid, new_rows):
+        def emit(valid, fw):
             valids.append(valid)
-            succs.append(new_rows)
+            succs.append(fw.done())
+
+        def w():  # one writer per action, all reads come from `rows`
+            return FieldWriter(pk, rows, coalesce=coalesce)
 
         # tm_commit / tm_abort
-        r = pk.set(rows, "tm", jnp.uint64(1))
-        r = pk.set(r, "msg_commit", jnp.ones_like(mc))
-        emit(tm_init & all_prepared, r)
-        r = pk.set(rows, "tm", jnp.uint64(2))
-        r = pk.set(r, "msg_abort", jnp.ones_like(ma))
-        emit(tm_init, r)
+        emit(
+            tm_init & all_prepared,
+            w().set("tm", jnp.uint64(1)).set("msg_commit", jnp.ones_like(mc)),
+        )
+        emit(
+            tm_init,
+            w().set("tm", jnp.uint64(2)).set("msg_abort", jnp.ones_like(ma)),
+        )
 
         for i in range(n):
             bit = jnp.uint64(1 << i)
@@ -344,26 +368,29 @@ class TwoPhaseTensor(TensorModel):
             # tm_rcv_prepared(i)
             emit(
                 tm_init & ((mprep >> jnp.uint64(i)) & one == one),
-                pk.set(rows, "tm_prepared", prep | bit),
+                w().set("tm_prepared", prep | bit),
             )
             # rm_prepare(i): rm working -> prepared + send prepared msg
-            r = pk.set(rows, "rm", rm_clear | (jnp.uint64(1) << jnp.uint64(2 * i)))
-            r = pk.set(r, "msg_prepared", mprep | bit)
-            emit(rm_i == jnp.uint64(0), r)
+            emit(
+                rm_i == jnp.uint64(0),
+                w()
+                .set("rm", rm_clear | (jnp.uint64(1) << jnp.uint64(2 * i)))
+                .set("msg_prepared", mprep | bit),
+            )
             # rm_choose_abort(i)
             emit(
                 rm_i == jnp.uint64(0),
-                pk.set(rows, "rm", rm_clear | (jnp.uint64(3) << jnp.uint64(2 * i))),
+                w().set("rm", rm_clear | (jnp.uint64(3) << jnp.uint64(2 * i))),
             )
             # rm_rcv_commit(i)
             emit(
                 mc == one,
-                pk.set(rows, "rm", rm_clear | (jnp.uint64(2) << jnp.uint64(2 * i))),
+                w().set("rm", rm_clear | (jnp.uint64(2) << jnp.uint64(2 * i))),
             )
             # rm_rcv_abort(i)
             emit(
                 ma == one,
-                pk.set(rows, "rm", rm_clear | (jnp.uint64(3) << jnp.uint64(2 * i))),
+                w().set("rm", rm_clear | (jnp.uint64(3) << jnp.uint64(2 * i))),
             )
 
         succ = jnp.stack(succs, axis=-2)  # [B, A, W]

@@ -1460,29 +1460,30 @@ class CompiledActorTensor(TensorModel):
 
     @property
     def has_coalesced_step(self) -> bool:
-        """Only the per-channel kernel has a coalesced form —
+        """Both compiled-twin kernels now have a real coalesced form —
+        the per-channel kernel since the expand-coalescing round, the
+        slot-multiset kernel since its packed-word write-backs were
+        threaded through the same :class:`FieldWriter` seam.
         ``ops/mxu.has_coalesced_step`` consults this so the engines and
-        the ledger's landed-recast bookkeeping both see the multiset
-        fallback (a fallen-back coalesce must never silence its JX400
-        findings)."""
-        return bool(self.per_channel)
+        the ledger's landed-recast bookkeeping agree on what the flag
+        actually moves."""
+        return True
 
     def step_rows_coalesced(self, rows):
         """Expand-scatter-coalesced step (``ops/mxu.py``,
-        docs/roofline.md): the per-channel kernel with each action
-        piece's packed-field write-backs assembled as ONE word-stacked
-        block (``FieldWriter`` coalesced mode) instead of one scatter
-        per field.  Successors/validity bit-identical to
-        :meth:`step_rows` (whole-space parity pinned in tests).
-        Slot-multiset twins have no coalesced form — their packed
-        writes are already few and the encoding is the JX302/JX305
-        story — so they fall back to the plain kernel (and advertise it
-        via :attr:`has_coalesced_step`)."""
+        docs/roofline.md): the same kernel with each action piece's
+        packed-field write-backs assembled as ONE word-stacked block
+        (``FieldWriter`` coalesced mode) instead of one full-block slice
+        read + scatter per field — both the per-channel kernel and the
+        slot-multiset kernel (whose history/timer/poison updates were
+        the remaining per-field scatter sites).  Successors/validity
+        bit-identical to :meth:`step_rows` (whole-space parity pinned in
+        tests)."""
         if self.per_channel:
             return self._step_rows_per_channel(rows, coalesce=True)
-        return self._step_rows_multiset(rows)
+        return self._step_rows_multiset(rows, coalesce=True)
 
-    def _step_rows_multiset(self, rows):
+    def _step_rows_multiset(self, rows, coalesce=False):
         import jax.numpy as jnp
 
         cst = self._consts()
@@ -1566,13 +1567,21 @@ class CompiledActorTensor(TensorModel):
         slots_d = slot_canonicalize(slots_d)
 
         # -- successor packed words -----------------------------------------
-        out = jnp.broadcast_to(rows[:, None, :], (B, NS, W))
+        # every value below reads from `rows`, never from the written
+        # block, so the writes thread through one FieldWriter: eager mode
+        # traces the per-field pk.set sites op-for-op, coalesced mode
+        # assembles them as one word-stacked concatenate (ops/mxu.py)
+        fw = FieldWriter(
+            pk,
+            jnp.broadcast_to(rows[:, None, :], (B, NS, W)),
+            coalesce=coalesce,
+        )
         for i in range(self.n_actors):
             cur = pk.get(rows, f"a{i}").astype(i32)[:, None]
             v = jnp.where(
                 valid & occupied & (dst == i), new_scode, cur
             )
-            out = pk.set(out, f"a{i}", v.astype(u64))
+            fw.set(f"a{i}", v.astype(u64))
         if self._has_timers:
             # a deliver's handler may set/cancel the recipient's timer
             timers_cur = pk.get(rows, "timers").astype(i32)  # [B]
@@ -1586,7 +1595,7 @@ class CompiledActorTensor(TensorModel):
                     tnew | (1 << i),
                     jnp.where(mask & (eff == 0), tnew & ~(1 << i), tnew),
                 )
-            out = pk.set(out, "timers", tnew.astype(u64))
+            fw.set("timers", tnew.astype(u64))
 
         # -- history updates -------------------------------------------------
         if self.C and self._multi:
@@ -1619,7 +1628,7 @@ class CompiledActorTensor(TensorModel):
                 new_ph = jnp.where(
                     m_w, cur_ph + 2, jnp.where(m_r, cur_ph + 1, cur_ph)
                 )
-                out = pk.set(out, f"h{c}_phase", new_ph.astype(u64))
+                fw.set(f"h{c}_phase", new_ph.astype(u64))
                 cur_comp = cur_ph >> 1  # [B, 1]
                 snap = jnp.zeros((B, NS), i32)
                 for j in range(self.C):
@@ -1632,14 +1641,12 @@ class CompiledActorTensor(TensorModel):
                     cur_snap = pk.get(rows, f"h{c}_snap{m}").astype(i32)[
                         :, None
                     ]
-                    out = pk.set(
-                        out,
+                    fw.set(
                         f"h{c}_snap{m}",
                         jnp.where(sel, snap, cur_snap).astype(u64),
                     )
                 cur_rv = pk.get(rows, f"h{c}_rval").astype(i32)[:, None]
-                out = pk.set(
-                    out,
+                fw.set(
                     f"h{c}_rval",
                     jnp.where(m_r, rv, cur_rv).astype(u64),
                 )
@@ -1675,7 +1682,7 @@ class CompiledActorTensor(TensorModel):
                     PHASE_R_INFLIGHT,
                     jnp.where(m_r, PHASE_DONE, cur_ph),
                 )
-                out = pk.set(out, f"h{c}_phase", new_ph.astype(u64))
+                fw.set(f"h{c}_phase", new_ph.astype(u64))
                 # read-invocation snapshot: other threads' completed counts
                 if self.C > 1:
                     snap = jnp.zeros((B, NS), i32)
@@ -1685,36 +1692,35 @@ class CompiledActorTensor(TensorModel):
                         slot = self.hist._snap_slot(c, j)
                         snap = snap | (comp[:, j : j + 1] << (2 * slot))
                     cur_snap = pk.get(rows, f"h{c}_snap").astype(i32)[:, None]
-                    out = pk.set(
-                        out,
+                    fw.set(
                         f"h{c}_snap",
                         jnp.where(m_w, snap, cur_snap).astype(u64),
                     )
                 cur_rv = pk.get(rows, f"h{c}_rval").astype(i32)[:, None]
-                out = pk.set(
-                    out,
+                fw.set(
                     f"h{c}_rval",
                     jnp.where(m_r, rv, cur_rv).astype(u64),
                 )
                 if self.hist.wfail_bits:
                     m_wf = m_w & (kind == _K_PUT_FAIL)
                     cur_wf = pk.get(rows, f"h{c}_wfail").astype(i32)[:, None]
-                    out = pk.set(
-                        out,
+                    fw.set(
                         f"h{c}_wfail",
                         jnp.where(m_wf, 1, cur_wf).astype(u64),
                     )
 
         cur_poison = pk.get(rows, "poison").astype(i32)[:, None]
-        out = pk.set(
-            out,
+        fw.set(
             "poison",
             jnp.maximum(jnp.where(poison, 1, 0), cur_poison).astype(u64),
         )
+        out = fw.done()
         succ = jnp.concatenate([out[:, :, : self.pw], slots_d], axis=-1)
 
         if not self.model.lossy:
-            return self._append_timeouts(rows, slots, cst, succ, valid)
+            return self._append_timeouts(
+                rows, slots, cst, succ, valid, coalesce=coalesce
+            )
 
         # -- drop actions (lossy networks): consume without delivering ------
         if self.ordered:
@@ -1750,9 +1756,12 @@ class CompiledActorTensor(TensorModel):
         succ = jnp.concatenate([succ, drop_rows], axis=1)
         droppable = at_head if self.ordered else occupied
         valid = jnp.concatenate([valid, droppable], axis=1)
-        return self._append_timeouts(rows, slots, cst, succ, valid)
+        return self._append_timeouts(
+            rows, slots, cst, succ, valid, coalesce=coalesce
+        )
 
-    def _append_timeouts(self, rows, slots, cst, succ, valid):
+    def _append_timeouts(self, rows, slots, cst, succ, valid,
+                         coalesce=False):
         """Append one Timeout action column per actor (reference
         ``model.rs:234-238,288-306``): valid iff the actor's timer bit is
         set; the tabulated ``on_timeout`` effect updates the actor state,
@@ -1769,7 +1778,14 @@ class CompiledActorTensor(TensorModel):
         NS = self.n_slots
         timers_cur = pk.get(rows, "timers").astype(i32)  # [B]
         col = jnp.arange(n, dtype=i32)[None, :]  # [1, n]
-        out_t = jnp.broadcast_to(rows[:, None, :], (B, n, self.width))
+        # same FieldWriter seam as the deliver block: every value reads
+        # from `rows`, so eager traces the pk.set sites op-for-op and
+        # coalesced assembles one word-stacked block (ops/mxu.py)
+        fw_t = FieldWriter(
+            pk,
+            jnp.broadcast_to(rows[:, None, :], (B, n, self.width)),
+            coalesce=coalesce,
+        )
         valid_t = ((timers_cur[:, None] >> col) & 1) == 1  # [B, n]
         poison_t = jnp.zeros((B, n), bool)
         tvals = []
@@ -1780,14 +1796,13 @@ class CompiledActorTensor(TensorModel):
             pi = cst["tpoison"][i][sc]
             nb = cst["tbit"][i][sc]
             send_cols.append(cst["tsends"][i][sc])  # [B, Kt]
-            out_t = pk.set(
-                out_t,
+            fw_t.set(
                 f"a{i}",
                 jnp.where(col == i, nc[:, None], sc[:, None]).astype(u64),
             )
             tvals.append((timers_cur & ~(1 << i)) | (nb << i))
             poison_t = poison_t | ((col == i) & pi[:, None])
-        out_t = pk.set(out_t, "timers", jnp.stack(tvals, 1).astype(u64))
+        fw_t.set("timers", jnp.stack(tvals, 1).astype(u64))
         slots_t = jnp.broadcast_to(slots[:, None, :], (B, n, NS))
         sk_all = jnp.stack(send_cols, axis=1)  # [B, n, Kt]
         for k in range(self.Kt):
@@ -1804,13 +1819,13 @@ class CompiledActorTensor(TensorModel):
                 )
             poison_t = poison_t | of
         cur_poison = pk.get(rows, "poison").astype(i32)[:, None]
-        out_t = pk.set(
-            out_t,
+        fw_t.set(
             "poison",
             jnp.maximum(
                 jnp.where(poison_t, 1, 0), cur_poison
             ).astype(u64),
         )
+        out_t = fw_t.done()
         slots_t = slot_canonicalize(slots_t)
         succ_t = jnp.concatenate([out_t[:, :, : self.pw], slots_t], axis=-1)
         return (

@@ -1,0 +1,229 @@
+"""Mesh-native sharded wavefront — GSPMD partitioning of the wavefront
+engine over a named ``('host', 'chip')`` mesh.
+
+The old sharded engine (``sharded.py``) hand-schedules the scale-out: a
+``shard_map`` body routes candidates to their owner with an explicit
+``lax.all_to_all`` and marks per-device values with vma casts
+(``jax.lax.pcast``/``pvary``) the pinned jax 0.4.37 does not have — the
+ROADMAP's standing sharded-failure class.  This engine inverts the
+responsibility: the *global* wavefront program (``wavefront.py``,
+unchanged — same jaxprs, same counters, same discovery rule) is handed
+to the compiler with the carry's placement expressed as
+``NamedSharding`` partition rules (``parallel/partition.py``), and GSPMD
+inserts the collectives:
+
+ - The visited table shards by bucket owner.  Table positions are
+   ``bucket * SLOTS + slot`` and a ``P(('host','chip'))`` sharding of
+   the row dimension gives shard ``k`` the contiguous range
+   ``[k*cap/D, (k+1)*cap/D)`` — a contiguous *bucket* range, so
+   "ownership" is a layout fact and candidate routing becomes the
+   all-to-all the compiler lowers for the scatter, not a hand-scheduled
+   collective.  With the PR 10 per-channel layout armed the (src,dst)
+   channel map makes those destinations static in the jaxpr.
+ - Queue/candidate buffers shard along the frontier dimension (when
+   divisible; replication otherwise — semantics never depend on it).
+ - Counters, discovery fingerprints, and termination state replicate.
+
+Because the program is the single-device engine's own, parity with it is
+by construction: counts, verdicts, discovery traces, and kill+resume
+snapshots are bit-identical (pinned by tests/test_mesh.py).  Zero
+``shard_map``/``pvary``/``pcast`` references — the engine compiles and
+runs on jax 0.4.37 and newer alike.
+
+Host-loop mechanics are inherited unchanged: growth, checkpointing, and
+resume round-trip the carry through host numpy; re-entry as plain numpy
+is fine because ``jax.jit``'s ``in_shardings`` re-shards inputs on the
+way in.  Multi-host (``jax.distributed``) runs share the axis names —
+each process contributes one ``host`` row — but the single-controller
+host loop can only pull *replicated* values there, so growth,
+checkpoint, and trace reconstruction require a fully addressable mesh
+today (pre-size ``capacity=`` on multi-host; docs/mesh.md).
+
+The spill tier stays single-device (the inherited ``_init_common``
+rejection), and ``pallas=True`` is rejected — the Pallas insert kernel
+is a single-device program (docs/pallas-insert-verdict.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..ops.buckets import SLOTS, bucket_of
+from ..ops.hashing import EMPTY
+from .prewarm import donation_supported
+from .partition import (
+    WAVEFRONT_CARRY_RULES,
+    build_mesh,
+    match_partition_rules,
+    replicated,
+    wavefront_carry_names,
+)
+from .wavefront import TpuChecker, _carry_avals
+
+
+class MeshTpuChecker(TpuChecker):
+    """Wavefront BFS partitioned over a named device mesh.
+
+    Spelled ``CheckerBuilder.mesh()`` / ``--mesh`` /
+    ``STATERIGHT_TPU_MESH=1`` (the old engine keeps the
+    ``devices=``/``n_devices=``/``mesh=`` spawn kwargs).  Everything but
+    placement is the single-device engine."""
+
+    _engine_tag = "mesh"
+
+    def __init__(
+        self,
+        options,
+        mesh: Optional[Mesh] = None,
+        n_devices: Optional[int] = None,
+        **kw,
+    ):
+        if kw.get("pallas"):
+            raise NotImplementedError(
+                "the Pallas insert kernel is a single-device program "
+                "(docs/pallas-insert-verdict.md); drop pallas=True for "
+                "the mesh engine"
+            )
+        kw["pallas"] = False  # neutralize STATERIGHT_TPU_PALLAS too
+        self._mesh = mesh if mesh is not None else build_mesh(n_devices)
+        self._mesh_stats_cache = None
+        super().__init__(options, **kw)
+
+    # -- engine construction -------------------------------------------------
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def n_devices(self) -> int:
+        return int(self._mesh.size)
+
+    def _engine_key(self, cap, qcap, batch, cand) -> tuple:
+        # the compiled-run cache lives on the tensor twin and is SHARED
+        # with single-device checkers of the same model: mesh entries
+        # must never collide with theirs (or with a different mesh's)
+        return super()._engine_key(cap, qcap, batch, cand) + (
+            ("mesh",) + tuple(d.id for d in self._mesh.devices.flat),
+        )
+
+    def _carry_shardings(self, cap, qcap, batch):
+        avals = _carry_avals(
+            self.tensor, len(self._props), cap, qcap, batch,
+            self._checked, self._cartography, self._por,
+            self._spill_cfg if self._spill else None,
+        )
+        names = wavefront_carry_names(
+            len(avals), checked=self._checked, por=self._por,
+            spill=bool(self._spill),
+        )
+        return match_partition_rules(
+            WAVEFRONT_CARRY_RULES, names, avals, self._mesh
+        )
+
+    def _build(self, cap, qcap, batch, cand):
+        """The single-device engine's own programs, re-jitted with the
+        carry's partition rules as in/out shardings.  GSPMD inserts the
+        cross-shard collectives; the traced computation — hence every
+        count, verdict, and discovery — is untouched."""
+        init_fn, run_fn = super()._build(cap, qcap, batch, cand)
+        shardings = self._carry_shardings(cap, qcap, batch)
+        rep = replicated(self._mesh)
+        mesh_init = jax.jit(init_fn, out_shardings=(shardings, rep))
+        mesh_run = jax.jit(
+            run_fn,
+            in_shardings=(shardings,),
+            out_shardings=(shardings, rep),
+            donate_argnums=(0,) if donation_supported() else (),
+        )
+        return mesh_init, mesh_run
+
+    def _pre_run_validate(self) -> None:
+        super()._pre_run_validate()
+        local = {d.id for d in jax.local_devices()}
+        if not all(d.id in local for d in self._mesh.devices.flat):
+            raise NotImplementedError(
+                "the mesh spans processes this controller cannot address: "
+                "multi-host growth/checkpointing needs a process-spanning "
+                "host loop (docs/mesh.md 'Multi-host'); pre-size "
+                "capacity= and run one controller per pod slice for now"
+            )
+
+    # -- per-shard load / routing imbalance (the A/B readout) ----------------
+
+    def mesh_stats(self) -> Optional[dict]:
+        """Per-shard visited-table load, the parent-owner -> child-owner
+        routing matrix, and the imbalance summary
+        (``ops/cartography.shard_imbalance``) — the measurable A/B
+        against the old engine.  None while the run is in flight.
+
+        Ownership is derived from the final table exactly as the
+        partition rules place it: position ``p`` belongs to shard
+        ``p // (cap/D)``; a parent's position is its bucket
+        (``ops/buckets.bucket_of``) times ``SLOTS``.  ``route[s][d]``
+        counts unique states owned by shard ``d`` whose parent is owned
+        by shard ``s`` (init states, parent fingerprint 0, are in
+        ``shard_load`` but route nowhere)."""
+        if not self._done.is_set() or self._final_carry is None:
+            return None
+        cached = self._mesh_stats_cache
+        if cached is not None and cached[0] is self._final_carry:
+            return dict(cached[1])
+        from ..ops.cartography import shard_imbalance
+
+        tfp, tpl = self._table_np()
+        d = self.n_devices
+        cap = tfp.shape[0]
+        rows_per_shard = cap // d if cap % d == 0 else cap  # guard parity
+        if rows_per_shard == cap and d > 1:
+            shards_of = np.zeros(cap, np.int64)  # replicated table: 1 owner
+        else:
+            shards_of = np.arange(cap, dtype=np.int64) // rows_per_shard
+        occupied = tfp != EMPTY
+        load = np.bincount(shards_of[occupied], minlength=d)[:d]
+        routed = occupied & (tpl != np.uint64(0))
+        child = shards_of[np.nonzero(routed)[0]]
+        parent_pos = bucket_of(tpl[routed], cap // SLOTS) * SLOTS
+        parent = parent_pos // rows_per_shard
+        route = np.zeros((d, d), np.int64)
+        np.add.at(route, (parent, child), 1)
+        out = {
+            "devices": d,
+            "axes": {k: int(v) for k, v in self._mesh.shape.items()},
+            "shard_load": [int(v) for v in load],
+            "imbalance": shard_imbalance(load),
+            "route_matrix": [[int(v) for v in row] for row in route],
+            "routed_states": int(route.sum()),
+        }
+        self._mesh_stats_cache = (self._final_carry, out)
+        return out
+
+    def _run_impl(self):
+        super()._run_impl()
+        # the imbalance readout rides the results + the cartography block
+        # (ops/cartography.snapshot key names: shard_load/shard_imbalance/
+        # route_matrix — same keys the old engine emits there)
+        try:
+            stats = self.mesh_stats() if self._results is not None else None
+        except Exception:  # noqa: BLE001 - a readout must never fail a run
+            stats = None
+        if stats is None:
+            return
+        self._results["mesh"] = stats
+        cart = self._results.get("cartography")
+        if isinstance(cart, dict):
+            cart.setdefault("shard_load", stats["shard_load"])
+            cart.setdefault("shard_imbalance", stats["imbalance"])
+            cart.setdefault("route_matrix", stats["route_matrix"])
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(
+                "mesh", devices=stats["devices"],
+                shard_load=stats["shard_load"],
+                imbalance=stats["imbalance"],
+                routed_states=stats["routed_states"],
+            )

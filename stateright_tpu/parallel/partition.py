@@ -1,0 +1,256 @@
+"""Named-mesh construction + partition rules for the mesh engine.
+
+One place for everything the GSPMD-partitioned wavefront needs to say
+about *placement* (``parallel/mesh.py`` says nothing — it only applies
+what this module decides):
+
+ - :data:`MESH_AXES` — the ``('host', 'chip')`` axis pair.  Today a
+   single process builds a ``1 x N`` mesh over its local devices;
+   launched under ``jax.distributed`` each process contributes its local
+   devices as one row, so the same axis names scale to DCN x ICI without
+   touching the partition rules (everything below shards over the
+   *flattened* pair).
+ - :func:`build_mesh` — the one constructor both the checker and the
+   tests use.
+ - :func:`match_partition_rules` — the regex-rule matcher (the
+   SNIPPETS.md [2]/[3] pattern): first rule whose pattern matches a
+   buffer's name decides its :class:`~jax.sharding.PartitionSpec`, with
+   two hard guards layered on top — scalars are always replicated, and a
+   dimension whose size the flattened mesh does not divide falls back to
+   replication (jax rejects uneven GSPMD shards with a ``ValueError``;
+   correctness never depends on a buffer *being* sharded, only on the
+   rules being applied consistently to inputs and outputs).
+ - :data:`WAVEFRONT_CARRY_RULES` — the partition-rule table for the
+   wavefront carry: visited table sharded by bucket owner (positions are
+   ``bucket * SLOTS + slot`` and :func:`jax.sharding.NamedSharding`
+   gives shard ``k`` the contiguous row range ``[k*cap/D, (k+1)*cap/D)``,
+   i.e. a contiguous *bucket* range — ownership is a layout fact, so
+   candidate routing becomes a sharding constraint the compiler lowers
+   to all-to-all/all-gather instead of a hand-scheduled collective),
+   queue/candidate buffers sharded along the frontier dimension, and
+   every counter/flag replicated.
+
+Compat shims live here too (the satellite dedupe): the ``shard_map``
+import dance the old sharded engine needs, and the per-engine
+collectives requirement — the OLD engine's ``shard_map`` body needs the
+vma-cast collectives (``jax.lax.pcast``/``pvary``) that the pinned jax
+0.4.37 lacks; the MESH engine deliberately needs neither (its programs
+are plain jitted global programs partitioned by in/out shardings), which
+is what turns the standing sharded-test failures into runnable coverage.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("host", "chip")
+
+
+# -- compat shims (ONE definition; sharded.py + tests/helpers.py import) -----
+
+def has_vma_collectives() -> bool:
+    """True when this jax exposes the vma-cast collectives
+    (``jax.lax.pcast`` / ``jax.lax.pvary``) the hand-rolled ``shard_map``
+    engine marks per-device values with.  The pinned jax 0.4.37 has
+    neither — the ROADMAP's standing sharded-failure class."""
+    return hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")
+
+
+def engine_requires_collectives(engine: str) -> bool:
+    """Per-engine collectives requirement (skips are per-engine, not
+    blanket): only the OLD shard_map engine (``"sharded"``) needs the vma
+    casts; the mesh engine's programs are jit-partitioned global programs
+    with zero ``pvary``/``pcast``/``shard_map`` references, and the
+    single-device engine never touches a collective at all."""
+    return engine == "sharded"
+
+
+try:  # jax >= 0.6 stable API
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+ENV_MESH = "STATERIGHT_TPU_MESH"
+
+
+def resolve_mesh_flag(mode, devices):
+    """Resolve the mesh-engine request: ``(enabled, n_devices)``.  An
+    explicit builder setting wins (``CheckerBuilder.mesh()``); otherwise
+    the ``STATERIGHT_TPU_MESH`` env knob — ``1`` arms the engine over
+    every local device, an integer ``N > 1`` bounds it to N devices, ``0``
+    /unset leaves it off.  Anything else warns LOUDLY and is ignored: a
+    typo'd knob must never masquerade as "the mesh engine buys
+    nothing"."""
+    import os
+    import sys
+
+    if mode is not None:
+        return bool(mode), devices
+    raw = os.environ.get(ENV_MESH, "")
+    if raw in ("", "0"):
+        return False, None
+    if raw == "1":
+        return True, None
+    try:
+        n = int(raw)
+        if n > 1:
+            return True, n
+    except ValueError:
+        pass
+    print(
+        f"stateright-tpu: ignoring malformed {ENV_MESH}={raw!r} "
+        "(expected 1, 0, or a device count > 1; docs/mesh.md)",
+        file=sys.stderr,
+    )
+    return False, None
+
+
+# -- mesh construction -------------------------------------------------------
+
+def build_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """The named ``('host', 'chip')`` mesh the engine partitions over.
+
+    Single process (the default): ``1 x N`` over the first ``n_devices``
+    local devices (all of them when unset).  Under ``jax.distributed``
+    (``jax.process_count() > 1``) every process contributes its local
+    devices as one ``host`` row — ``n_devices`` then bounds the per-host
+    chip count.  An explicit ``devices`` sequence wins outright (tests
+    build deliberate sub-meshes with it)."""
+    if devices is not None:
+        devs = list(devices)
+        return Mesh(np.asarray(devs).reshape(1, len(devs)), MESH_AXES)
+    procs = jax.process_count()
+    if procs > 1:
+        all_devs = jax.devices()
+        per_host = len(all_devs) // procs
+        if n_devices is not None:
+            per_host = min(per_host, int(n_devices))
+        grid = np.asarray(all_devs[: procs * per_host]).reshape(
+            procs, per_host
+        )
+        return Mesh(grid, MESH_AXES)
+    devs = jax.devices()
+    if n_devices is not None:
+        if int(n_devices) > len(devs):
+            raise ValueError(
+                f"mesh engine asked for {n_devices} devices but only "
+                f"{len(devs)} are visible (force more with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N on CPU)"
+            )
+        devs = devs[: int(n_devices)]
+    return Mesh(np.asarray(devs).reshape(1, len(devs)), MESH_AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """The fully replicated placement (counters, flags, packed stats)."""
+    return NamedSharding(mesh, P())
+
+
+# -- partition rules ---------------------------------------------------------
+
+# First match wins (the match_partition_rules contract).  The visited
+# table shards by bucket owner; queue/frontier buffers shard along the
+# row dimension; the terminal catch-all replicates counters, discovery
+# fingerprints, status/error flags, and every capacity-independent tail
+# (POR stats, cartography counters).
+WAVEFRONT_CARRY_RULES = (
+    (r"^table_", P(MESH_AXES)),
+    (r"^q_", P(MESH_AXES)),
+    (r".*", P()),
+)
+
+
+def match_partition_rules(rules, names: Sequence[str], avals,
+                          mesh: Mesh):
+    """Resolve one :class:`NamedSharding` per named buffer.
+
+    ``rules`` is a sequence of ``(pattern, PartitionSpec)`` pairs; the
+    first pattern that ``re.search``-matches a buffer's name decides its
+    spec (a name no rule matches is an error — rule tables end with a
+    catch-all on purpose, so a miss means the table and the carry layout
+    drifted apart).  Two guards override any matched spec:
+
+     - rank-0 buffers are replicated (nothing to shard);
+     - a dimension whose global size the product of the spec's mesh axes
+       does not divide is replicated instead — jax raises on uneven
+       GSPMD shards, and replication is always semantically equivalent.
+    """
+    out = []
+    for name, aval in zip(names, avals):
+        spec = None
+        for pat, rule_spec in rules:
+            if re.search(pat, name):
+                spec = rule_spec
+                break
+        if spec is None:
+            raise ValueError(
+                f"no partition rule matches carry buffer {name!r} — the "
+                "rule table and the carry layout drifted apart"
+            )
+        if getattr(aval, "ndim", 0) == 0:
+            spec = P()
+        else:
+            parts = list(spec)
+            for dim, axes in enumerate(parts):
+                if axes is None:
+                    continue
+                axis_names = (axes,) if isinstance(axes, str) else tuple(axes)
+                size = int(
+                    np.prod([mesh.shape[a] for a in axis_names])
+                )
+                if dim >= aval.ndim or aval.shape[dim] % size != 0:
+                    parts[dim] = None
+            spec = P(*parts)
+        out.append(NamedSharding(mesh, spec))
+    return tuple(out)
+
+
+# Wavefront carry buffer names, in carry order (mirrors _SNAPSHOT_KEYS +
+# the optional tails _carry_avals appends).  The mesh engine derives the
+# names from the SAME flags it builds avals with, so the two cannot
+# disagree in length without tripping the zip in match_partition_rules.
+_BASE_CARRY_NAMES = (
+    "table_fp", "table_parent", "q_rows", "q_fp", "q_ebits",
+    "q_depth", "head", "tail", "unique", "scount", "disc", "maxdepth",
+    "status",
+)
+
+_SPILL_TAIL_NAMES = (
+    "spill_bloom", "spill_base", "spill_pend_fp", "spill_pend_rows",
+    "spill_pend_par", "spill_pend_ebt", "spill_pend_dep",
+    "spill_pend_n", "spill_stats",
+)
+
+
+def wavefront_carry_names(n_total: int, *, checked: bool = False,
+                          por: bool = False, spill: bool = False) -> tuple:
+    """Names for an ``n_total``-element wavefront carry built with these
+    feature flags (the cartography counter tail, whatever its length,
+    fills the remainder — it is replicated either way)."""
+    names = list(_BASE_CARRY_NAMES)
+    if checked:
+        names.append("err")
+    if por:
+        names += ["por_boost", "por_stats"]
+    if spill:
+        names += list(_SPILL_TAIL_NAMES)
+    if len(names) > n_total:
+        raise ValueError(
+            f"carry has {n_total} buffers but the flags imply at least "
+            f"{len(names)} — feature flags and carry layout disagree"
+        )
+    names += [f"cart_{i}" for i in range(n_total - len(names))]
+    return tuple(names)

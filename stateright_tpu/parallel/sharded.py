@@ -50,16 +50,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 stable API
-    from jax import shard_map as _shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs):
-        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    def shard_map(f, mesh, in_specs, out_specs):
-        return _shard_map_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+# shard_map import/compat shim: ONE definition, shared with the skip
+# helpers (parallel/partition.py) — only THIS engine needs the vma-cast
+# collectives; the mesh engine (parallel/mesh.py) runs without them
+from .partition import shard_map  # noqa: F401 - re-exported for tests
 
 from ..checker.base import CheckerBuilder
 from ..core import Expectation

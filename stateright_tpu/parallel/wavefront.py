@@ -2220,7 +2220,13 @@ class TpuChecker(WavefrontChecker):
                 syncs += 1
                 rec.add_bytes(d2h=stats.nbytes)
                 rec.step(
-                    engine="wavefront", states=scount, unique=unique,
+                    # subclass engines (the mesh engine) reuse this loop:
+                    # telemetry must carry the tag of the engine that ran
+                    engine=(
+                        "wavefront" if self._engine_tag == "single"
+                        else self._engine_tag
+                    ),
+                    states=scount, unique=unique,
                     depth=maxdepth, status=status,
                     queue=max(tail - head, 0), cap=cap, cand=cand,
                     # HOT occupancy with the spill tier armed: evicted

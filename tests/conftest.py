@@ -37,3 +37,17 @@ assert len(jax.devices()) == 8, (
 )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """``medium`` implies ``slow`` for selection: pytest.ini documents
+    medium as "run with the daily slow tier", so the fast tier's
+    ``-m 'not slow'`` must deselect it without every harness having to
+    spell ``not slow and not medium``.  The daily tier's ``slow or
+    medium`` selection is unaffected, and every medium test keeps a
+    cheaper fast-tier sibling (the re-tiering discipline)."""
+    for item in items:
+        if "medium" in item.keywords:
+            item.add_marker(pytest.mark.slow)

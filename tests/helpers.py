@@ -1,18 +1,28 @@
 """Shared pytest helpers for the device-engine tests.
 
 ``requires_sharded_collectives`` is THE skip marker for tests that drive
-the mesh-sharded engine: it needs the vma-cast collectives
+the OLD hand-rolled ``shard_map`` engine (``parallel/sharded.py``): its
+body marks per-device values with the vma-cast collectives
 (``jax.lax.pcast`` / ``jax.lax.pvary``) that the pinned local jax lacks —
-the same pre-existing failure class ROADMAP tracks as the 23 standing
-sharded failures.  One definition here instead of a copied ``skipif``
-expression per test file, so a jax upgrade flips every sharded test on in
-one place.
+the same pre-existing failure class ROADMAP tracks as the standing
+sharded failures.  The requirement is PER-ENGINE
+(``parallel/partition.engine_requires_collectives``): the mesh engine
+(``parallel/mesh.py``) partitions plain jitted global programs with
+``NamedSharding`` rules and needs neither collective, so its tests RUN
+(never skip) on jax 0.4.37.  One definition here instead of a copied
+``skipif`` expression per test file, so a jax upgrade flips every
+old-engine test on in one place.
 """
 
-import jax
 import pytest
 
+from stateright_tpu.parallel.partition import (
+    engine_requires_collectives,
+    has_vma_collectives,
+)
+
 requires_sharded_collectives = pytest.mark.skipif(
-    not (hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary")),
-    reason="sharded engine needs vma casts this jax lacks",
+    engine_requires_collectives("sharded") and not has_vma_collectives(),
+    reason="the shard_map engine needs vma casts this jax lacks "
+    "(the mesh engine does not — its tests never take this skip)",
 )

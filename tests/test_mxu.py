@@ -111,8 +111,8 @@ def test_mxu_off_leaves_run_jaxpr_bit_identical():
 def test_mxu_engine_cache_key_pin():
     """OFF leaves the cache key exactly the pre-MXU tuple (unkeyed by
     the feature's absence); ON appends the EFFECTIVE component tuple —
-    a component that falls back to an identical program (no coalesced
-    kernel on this twin) is keyed off, so equivalent configs share one
+    a component that falls back to an identical program (a twin without
+    a coalesced kernel) is keyed off, so equivalent configs share one
     engine compile."""
     off = _spawn(TwoPhaseSys(3))
     on = _spawn(TwoPhaseSys(3), mxu=True)
@@ -122,13 +122,18 @@ def test_mxu_engine_cache_key_pin():
         isinstance(e, tuple) and e and e[0] == "mxu" for e in k_off
     )
     assert k_on[:-1] == k_off
-    # the 2pc hand twin has no coalesced kernel: effective coalesce off
-    assert k_on[-1] == ("mxu", False, True, True)
+    # the 2pc hand twin gained a real coalesced kernel (the FieldWriter
+    # round): the component keys ON and the config is its own entry
+    assert k_on[-1] == ("mxu", True, True, True)
     no_co = _spawn(TwoPhaseSys(3), mxu={"coalesce": False})
-    assert k_on == no_co._engine_key(
+    k_no_co = no_co._engine_key(
         no_co._cap, no_co._qcap, no_co._batch, no_co._cand
-    ), "fallback-equivalent configs must share one cache entry"
-    # a twin WITH a coalesced kernel keys the component on
+    )
+    assert k_no_co[-1] == ("mxu", False, True, True)
+    assert k_on != k_no_co
+    # effective_mxu still downgrades for twins WITHOUT a coalesced
+    # kernel (ops/mxu.py fallback pin lives in
+    # test_coalesced_step_fn_fallback_without_method)
     pax = paxos_model(1, 3).checker().mxu().spawn_tpu(
         sync=True, capacity=1 << 15, batch=256
     )
@@ -333,32 +338,35 @@ def test_coalesced_step_fn_fallback_without_method():
     ) == t2.step_rows
 
 
-def test_multiset_compiled_twin_coalesce_falls_back_honestly():
-    """The slot-multiset compiled twin DEFINES step_rows_coalesced but
-    falls back internally (per_channel only) — has_coalesced_step must
-    expose that, so the engines trace the plain kernel directly and the
-    ledger never marks its expand scatters recast_landed."""
+def test_multiset_compiled_twin_coalesce_is_real():
+    """The slot-multiset compiled twin's coalesce is REAL since its
+    history/timer/poison write-backs were threaded through the
+    FieldWriter seam — has_coalesced_step advertises it, the engines
+    trace the coalesced kernel, and its successors stay bit-identical
+    over the whole actor-2pc space."""
     from fixtures_actor import actor_2pc_model
 
-    from stateright_tpu.analysis.costmodel import wavefront_costs
     from stateright_tpu.ops.mxu import has_coalesced_step
 
     ms = actor_2pc_model(2)._tensor_cached()
-    assert not has_coalesced_step(ms)
-    assert coalesced_step_fn(ms, MxuConfig()) == ms.step_rows
+    assert has_coalesced_step(ms)
+    assert coalesced_step_fn(ms, MxuConfig()) == ms.step_rows_coalesced
     pc = actor_2pc_model(2)
     pc.per_channel_()
     tpc = pc._tensor_cached()
     assert has_coalesced_step(tpc)
     assert coalesced_step_fn(tpc, MxuConfig()) == tpc.step_rows_coalesced
-    on = wavefront_costs(
-        ms, 1 << 12, 1 << 11, 128, reconcile=False, mxu=MxuConfig()
+    assert _crawl_step_parity(ms, max_unique=6000) == _crawl_step_parity(
+        tpc, max_unique=6000
     )
-    assert not any(
-        c.get("recast_landed")
-        for c in on.candidates
-        if c["stage"] == "expand" and c["op_class"] == "scatter"
-    ), "multiset fallback must not mark expand scatters landed"
+
+
+def test_coalesced_whole_space_parity_hand_twin_2pc3():
+    """The 2pc hand twin's new coalesced kernel: bit-identical
+    successors over the whole 2pc-3 space (the per-action FieldWriter
+    assembly must preserve every mask and write)."""
+    t = TwoPhaseSys(3).tensor_model()
+    assert _crawl_step_parity(t) == TPC3_UNIQUE
 
 
 # -- cost-model payoff (the regress --mxu bars, statically) -------------------
@@ -430,19 +438,11 @@ def test_jx400_escape_hatch_pre_flag_and_silent_post():
         for c in on.candidates
         if c["stage"] == "dedup-insert" and c["op_class"] == "gather"
     )
-    # honesty pin: 2pc's hand twin has NO coalesced kernel, so the
-    # coalesce component falls back (effective_mxu) — its expand
-    # scatters are NOT marked landed and their finding keeps firing
-    assert not any(
-        c.get("recast_landed")
-        for c in on.candidates
-        if c["stage"] == "expand" and c["op_class"] == "scatter"
-    )
-    assert [
-        f for f in on.findings
-        if f.rule_id == "JX400" and "expand" in f.location
-        and "scatter" in f.message
-    ], "the fallen-back expand scatter finding must stay live"
+    # 2pc's hand twin gained a real coalesced kernel (the FieldWriter
+    # round): its expand scatters vanish from the flagged trace, exactly
+    # like the paxos hand twin's
+    assert "scatter" in off.stages["expand"].classes
+    assert "scatter" not in on.stages["expand"].classes
 
 
 # -- roofline two-peak verdicts -----------------------------------------------
@@ -713,8 +713,8 @@ def test_mxu_parity_on_sharded_engine():
         isinstance(e, tuple) and e and e[0] == "mxu"
         for e in a._last_engine_key
     )
-    # (the 2pc hand twin has no coalesced kernel: effective coalesce off)
-    assert b._last_engine_key[-1] == ("mxu", False, True)
+    # (the 2pc hand twin gained a real coalesced kernel: keyed on)
+    assert b._last_engine_key[-1] == ("mxu", True, True)
     c = TwoPhaseSys(3).checker().mxu(
         coalesce=False, slim_queue=True, probe=False
     ).spawn_tpu(

@@ -10,6 +10,8 @@ reference has one strategy family); the CLI shape being served is
 ``examples/paxos.rs:314-395``'s check commands.
 """
 
+import pytest
+
 from stateright_tpu.checker.bfs import BfsChecker
 from stateright_tpu.checker.dfs import DfsChecker
 from stateright_tpu.models.two_phase_commit import TwoPhaseSys
@@ -78,6 +80,7 @@ def test_visitor_small_space_finishes_on_thread_probe():
     assert len(seen) == 288
 
 
+@pytest.mark.medium
 def test_visitor_large_space_escalates_to_mp(monkeypatch):
     """A visitor run whose space outgrows the probe escalates to the
     process-parallel BFS (multi-core + visitor via replay), never to a
@@ -99,6 +102,7 @@ def test_visitor_large_space_escalates_to_mp(monkeypatch):
     assert len(seen) == 8832
 
 
+@pytest.mark.medium
 def test_visitor_escalation_defers_visits_to_run_end(monkeypatch):
     """ADVICE item 6 — the visitor-timing hole, pinned: when a visitor
     run escalates to mp-BFS, the callbacks are DEFERRED TO RUN END.

@@ -185,6 +185,7 @@ def test_cohort_grouping_and_const_lifting():
 # -- the acceptance sweep ----------------------------------------------------
 
 
+@pytest.mark.medium
 def test_eight_instance_sweep_one_compile_full_parity():
     """ISSUE 15 acceptance: 8 bound-swept instances, ONE cohort engine
     compile (compile-event count) versus 8 sequentially, and every

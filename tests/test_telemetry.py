@@ -244,6 +244,7 @@ def test_wavefront_growth_records_with_occupancy():
     assert c.unique_state_count() == 8832  # growth preserved the work
 
 
+@pytest.mark.medium
 def test_profiler_scoped_trace(tmp_path):
     logdir = tmp_path / "prof"
     c = (
