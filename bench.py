@@ -67,6 +67,13 @@ the ``tpu_sweep_states_per_sec`` /
 ``tpu_sweep_sequential_states_per_sec`` aggregate-throughput pair;
 ``regress.py --sweep`` gates the block's well-formedness and parity.
 
+``BENCH_LIVE=1`` adds the flag-gated live-observability leg
+(docs/observability.md): paxos-3 with plain telemetry vs telemetry +
+metrics bus + armed progress heartbeat — count parity ASSERTED, the
+measured bus-sampling + heartbeat-write overhead fraction recorded as
+``tpu_live.overhead_frac`` next to the published family list and the
+terminal heartbeat; ``regress.py --live`` gates the block.
+
 Run ledger (docs/telemetry.md "Comparing runs"): with
 ``STATERIGHT_TPU_RUN_DIR`` set, EVERY device leg bench runs is archived
 into the persistent run registry (``telemetry/registry.py``) — one
@@ -1418,6 +1425,89 @@ def tpu_phase() -> dict:
             _mark("mesh leg done")
         except Exception as e:  # noqa: BLE001 - same never-void rule
             out["tpu_mesh_error"] = f"{type(e).__name__}: {e}"
+        _persist(out)
+
+    # flag-gated LIVE leg (BENCH_LIVE=1; docs/observability.md): paxos-3
+    # with plain telemetry (base) vs telemetry + metrics bus + armed
+    # progress heartbeat (live).  Count parity vs the base run is
+    # ASSERTED (the bus and heartbeat sample host syncs that already
+    # happen; instrumentation that changes counts broke the
+    # zero-overhead contract outright), and the block carries the
+    # measured overhead fraction next to the published family list and
+    # the terminal heartbeat — what regress.py --live gates.
+    if os.environ.get("BENCH_LIVE", "") == "1":
+        import shutil
+        import tempfile
+
+        try:
+            from stateright_tpu.checkpoint import read_progress
+            from stateright_tpu.models.paxos import paxos_model
+            from stateright_tpu.telemetry.metrics import (
+                default_bus,
+                reset_default_bus,
+            )
+
+            m_lv = paxos_model(3)
+            kw_lv = dict(sync=True, capacity=1 << 18,
+                         queue_capacity=1 << 16, batch=1024,
+                         steps_per_call=64)
+
+            def run_base():
+                return m_lv.checker().telemetry(capacity=2048).spawn_tpu(
+                    **kw_lv
+                )
+
+            _mark("live leg (warm-up)")
+            run_base()  # warm-up (compile; cache shared with both runs)
+            _mark("live leg (base run)")
+            t_lb = time.monotonic()
+            cb = run_base()
+            dt_lb = time.monotonic() - t_lb
+            hb_dir = tempfile.mkdtemp(prefix="bench-live-")
+            try:
+                reset_default_bus()
+                _mark("live leg (instrumented run)")
+                t_lv = time.monotonic()
+                # every_secs high enough that no snapshot generation is
+                # ever due: the leg measures bus sampling + heartbeat
+                # writes, not checkpoint serialization (the autosave arm
+                # is what arms the heartbeat)
+                cl = (
+                    m_lv.checker()
+                    .telemetry(capacity=2048, metrics=True)
+                    .autosave(hb_dir, every_secs=3600.0)
+                    .spawn_tpu(**kw_lv)
+                )
+                dt_lv = time.monotonic() - t_lv
+                pair_b = (cb.unique_state_count(), cb.state_count())
+                pair_l = (cl.unique_state_count(), cl.state_count())
+                if pair_b != pair_l:
+                    raise AssertionError(
+                        f"live-vs-base count drift: {pair_l} != {pair_b}"
+                    )
+                hb = read_progress(hb_dir) or {}
+                out["tpu_live"] = {
+                    "model": "paxos-3",
+                    "unique": int(pair_l[0]),
+                    "states": int(pair_l[1]),
+                    "parity": "IDENTICAL",
+                    "base_sec": round(dt_lb, 3),
+                    "live_sec": round(dt_lv, 3),
+                    "overhead_frac": round(
+                        max(dt_lv - dt_lb, 0.0) / max(dt_lb, 1e-9), 3
+                    ),
+                    "families": default_bus().families(),
+                    "heartbeat": {
+                        k: hb.get(k)
+                        for k in ("verdict", "status", "states",
+                                  "unique", "steps")
+                    },
+                }
+            finally:
+                shutil.rmtree(hb_dir, ignore_errors=True)
+            _mark("live leg done")
+        except Exception as e:  # noqa: BLE001 - same never-void rule
+            out["tpu_live_error"] = f"{type(e).__name__}: {e}"
         _persist(out)
 
     # reference bench protocol on device.  All five configs compile — the

@@ -9,7 +9,7 @@ staleness and regressions LOUD:
                       [--tolerance=0.85] [--allow-stale] [--sanitize]
                       [--stages] [--cartography] [--independence]
                       [--memory] [--spill] [--roofline] [--mxu]
-                      [--sweep] [--fleet] [--mesh] [--diff]
+                      [--sweep] [--fleet] [--mesh] [--diff] [--live]
 
 ``RUN.json`` (default ``docs/bench-last-details.json``) is a bench details
 artifact — any JSON object with ``fresh`` and ``*_states_per_sec`` keys
@@ -896,6 +896,107 @@ def mesh_verdict(run: dict, baseline: dict) -> dict:
     return out
 
 
+# Telemetry-on overhead ceiling for the --live gate: the live leg samples
+# the metrics bus and writes the progress heartbeat only at host syncs
+# that already happen, so the instrumented run must stay within this
+# fraction of the plain-telemetry run.  0.35 leaves slack for CPU-only CI
+# jitter on a sub-second paxos-3 check while still catching a leg that
+# re-introduces per-step device round-trips (which costs integer
+# multiples, not fractions).
+LIVE_OVERHEAD_MAX = 0.35
+
+
+def live_verdict(run: dict, baseline: dict) -> dict:
+    """``--live``: the live-observability leg (docs/observability.md).
+
+    The leg is FLAG-gated (``BENCH_LIVE=1``), so absence never trips —
+    stale artifacts and pre-observability baselines pass untouched (the
+    spill/mxu/sweep/fleet/mesh rule).  When a fresh run carries it:
+
+     - a crashed leg (``tpu_live_error``) is a gate failure, not a skip;
+     - the block must be WELL-FORMED: positive unique/state counts with
+       ``states >= unique``;
+     - count parity must have held (``parity == "IDENTICAL"`` — the bus
+       and heartbeat ride host syncs that already happen; an
+       instrumented run that changes counts broke the zero-overhead
+       contract outright);
+     - the sampling + heartbeat overhead must stay within
+       ``LIVE_OVERHEAD_MAX`` of the plain-telemetry run
+       (``overhead_frac``);
+     - the bus must actually have published (``families`` includes
+       ``stateright_states_total``) and the run's terminal heartbeat
+       must exist with verdict ``done``.
+    """
+    out: dict = {}
+    problems = []
+    err = run.get("tpu_live_error")
+    blk = run.get("tpu_live")
+    present = bool(err) or blk is not None
+    if err:
+        problems.append(f"leg crashed: tpu_live: {err}")
+    if blk is not None and not isinstance(blk, dict):
+        problems.append("tpu_live block is not an object")
+        blk = None
+    if isinstance(blk, dict):
+        ints = {}
+        for k in ("unique", "states"):
+            v = blk.get(k)
+            if not isinstance(v, int) or v <= 0:
+                problems.append(f"tpu_live.{k} missing/malformed: {v!r}")
+            else:
+                ints[k] = v
+        if (
+            {"unique", "states"} <= set(ints)
+            and ints["states"] < ints["unique"]
+        ):
+            problems.append(
+                f"tpu_live.states={ints['states']} < "
+                f"unique={ints['unique']} (total visits bound uniques)"
+            )
+        if blk.get("parity") != "IDENTICAL":
+            problems.append(
+                f"tpu_live.parity={blk.get('parity')!r} (metrics+heartbeat "
+                "instrumentation must not change counts — the bus samples "
+                "host syncs that already happen)"
+            )
+        frac = blk.get("overhead_frac")
+        if not isinstance(frac, (int, float)):
+            problems.append(
+                f"tpu_live.overhead_frac missing/malformed: {frac!r}"
+            )
+        elif frac > LIVE_OVERHEAD_MAX:
+            problems.append(
+                f"tpu_live.overhead_frac={frac} exceeds the pinned "
+                f"{LIVE_OVERHEAD_MAX} ceiling (bus sampling + heartbeat "
+                "writes must stay a fraction of the run, not a multiple)"
+            )
+        else:
+            out["overhead_frac"] = frac
+        fams = blk.get("families")
+        if (
+            not isinstance(fams, list)
+            or "stateright_states_total" not in fams
+        ):
+            problems.append(
+                f"tpu_live.families missing stateright_states_total: "
+                f"{fams!r} (an instrumented run whose bus never published "
+                "measured nothing)"
+            )
+        hb = blk.get("heartbeat")
+        if not isinstance(hb, dict) or hb.get("verdict") != "done":
+            problems.append(
+                f"tpu_live.heartbeat verdict is not 'done': "
+                f"{(hb or {}).get('verdict') if isinstance(hb, dict) else hb!r} "
+                "(the terminal forced beat must land)"
+            )
+    out["present"] = present
+    out["ok"] = not problems  # flag-gated: absence is not a failure
+    if problems:
+        out["problems"] = problems
+    out["baseline_present"] = bool(baseline.get("tpu_live"))
+    return out
+
+
 def diff_verdict(run: dict, baseline: dict) -> dict:
     """``--diff``: the contract-aware report diff
     (``telemetry/diff.py``; docs/telemetry.md "Comparing runs").
@@ -978,6 +1079,7 @@ def main(argv=None, fleet=None) -> int:
     tolerance, allow_stale, sanitize = DEFAULT_TOLERANCE, False, False
     stages = cartography = independence = memory = spill = False
     roofline = diff = mxu = sweep = fleet_gate = mesh_gate = False
+    live_gate = False
     pos = []
     for a in argv:
         if a.startswith("--baseline="):
@@ -1008,6 +1110,8 @@ def main(argv=None, fleet=None) -> int:
             fleet_gate = True
         elif a == "--mesh":
             mesh_gate = True
+        elif a == "--live":
+            live_gate = True
         elif a == "--diff":
             diff = True
         else:
@@ -1101,6 +1205,14 @@ def main(argv=None, fleet=None) -> int:
         # spill/mxu/sweep/fleet rule)
         if verdict["fresh"]:
             verdict["ok"] = verdict["ok"] and verdict["mesh"]["ok"]
+    if live_gate:
+        verdict["live"] = live_verdict(run, baseline)
+        # flag-gated leg: absence passes; a present-but-crashed,
+        # parity-breaking, or over-budget leg trips fresh runs only
+        # (stale/pre-observability baselines never trip — the
+        # spill/mxu/sweep/fleet/mesh rule)
+        if verdict["fresh"]:
+            verdict["ok"] = verdict["ok"] and verdict["live"]["ok"]
     if diff:
         verdict["diff"] = diff_verdict(run, baseline)
         # same freshness rule: stale artifacts and pre-registry
@@ -1242,6 +1354,19 @@ def main(argv=None, fleet=None) -> int:
             "readout (tpu_mesh; see stdout JSON) — a partitioned engine "
             "that drifts or cannot account for its own placement is not "
             "an A/B (docs/mesh.md)\n"
+        )
+        return 1
+    if (
+        "live" in verdict
+        and verdict["fresh"]
+        and not verdict["live"]["ok"]
+    ):
+        sys.stderr.write(
+            "regress: the live-observability leg is malformed, crashed, "
+            "drifted its counts, or blew the pinned telemetry-on overhead "
+            "ceiling (tpu_live; see stdout JSON) — a metrics bus that "
+            "changes the run it observes is not observability "
+            "(docs/observability.md)\n"
         )
         return 1
     if (

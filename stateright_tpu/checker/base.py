@@ -62,6 +62,11 @@ class CheckerBuilder:
         # None = env default (STATERIGHT_TPU_MESH, off when unset)
         self.mesh_mode: Optional[bool] = None
         self.mesh_devices: Optional[int] = None
+        # span-trace context (telemetry/spans.py): set by the fleet
+        # scheduler / supervisor so spawned engines parent their
+        # engine_run spans under the job/attempt span; None = the engine
+        # roots a fresh trace (standalone check)
+        self._span_ctx = None
 
     # -- configuration -------------------------------------------------------
 
@@ -107,6 +112,7 @@ class CheckerBuilder:
         memory: bool = False,
         memory_every: int = 32,
         roofline: bool = False,
+        metrics: bool = False,
     ) -> "CheckerBuilder":
         """Attach a flight recorder to the spawned checker
         (``stateright_tpu/telemetry/``; schema in ``docs/telemetry.md``).
@@ -156,6 +162,16 @@ class CheckerBuilder:
         report's ``roofline`` block, ``/.metrics``, and the
         ``costmodel`` CLI verb.
 
+        ``metrics=True`` attaches the process-wide live metrics bus
+        (``telemetry/metrics.py``, docs/observability.md): the recorder
+        publishes the engine metric families (states/s, frontier size,
+        table load, dedup rate, step-time histogram) at host syncs that
+        already happen, and the Explorer serves them as Prometheus text
+        on ``GET /metrics``.  ``STATERIGHT_TPU_METRICS=1`` is the env
+        form.  Pure host-side aggregation of values already in hand —
+        zero extra device round-trips, and with the bus detached the
+        step-record stream is bit-identical (parity pinned by test).
+
         ``cartography=True`` additionally folds the search-cartography
         counters into the device step (``ops/cartography.py``,
         docs/telemetry.md): per-depth frontier sizes, the per-action
@@ -186,6 +202,9 @@ class CheckerBuilder:
         implied_roof = bool(self.telemetry_opts) and bool(
             self.telemetry_opts.get("roofline")
         )
+        implied_metrics = bool(self.telemetry_opts) and bool(
+            self.telemetry_opts.get("metrics")
+        )
         # a previously configured cadence is part of the sticky ledger
         # config: keep it unless this call sets one explicitly
         prev_every = (
@@ -204,6 +223,7 @@ class CheckerBuilder:
                 prev_every if prev_every is not None else memory_every
             ),
             "roofline": bool(roofline) or implied_roof,
+            "metrics": bool(metrics) or implied_metrics,
         }
         return self
 
@@ -552,12 +572,18 @@ class CheckerBuilder:
             return None
         from ..telemetry import FlightRecorder
 
+        metrics = None
+        if self.telemetry_opts.get("metrics"):
+            from ..telemetry import default_bus
+
+            metrics = default_bus()
         return FlightRecorder(
             capacity=self.telemetry_opts["capacity"],
             meta={
                 "engine": engine,
                 "model": type(self.model).__name__,
             },
+            metrics=metrics,
         )
 
     # -- static preflight audit (stateright_tpu/analysis/) -------------------

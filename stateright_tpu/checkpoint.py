@@ -338,3 +338,109 @@ def stub_report_doc(manifest: dict) -> Optional[dict]:
     if manifest.get("parent_run_id"):
         doc["parent_run_id"] = manifest["parent_run_id"]
     return doc
+
+
+# -- live progress heartbeat (docs/observability.md) --------------------------
+
+PROGRESS_V = 1
+PROGRESS_FILE = "progress.json"
+
+# a "running" heartbeat older than beats_every * this factor means the
+# writer is gone (SIGKILLed) or wedged — the post-mortem verdict the
+# ``status`` CLI verb renders
+STALE_FACTOR = 5.0
+
+
+class ProgressHeartbeat:
+    """Atomic ``progress.json`` writer next to the autosave generations.
+
+    The engines beat it at every host sync they already make (throttled
+    to ``every_secs``), so ``python -m stateright_tpu.models._cli status
+    <run_dir>`` can tail ANY headless run — including one that was
+    SIGKILLed mid-flight: the file survives with the last beaten
+    counters and a wall-clock ``ts``, and a stale ``ts`` on a
+    ``running`` status IS the post-mortem ("where did it stall").
+    Every write rides the atomic discipline (``telemetry/_atomic.py``) —
+    a reader never sees a torn file.  Write failures degrade silently
+    (drop the beat, keep the run): liveness reporting must never kill
+    the run it reports on."""
+
+    def __init__(self, root: str, every_secs: float = 1.0,
+                 meta: Optional[dict] = None):
+        self.path = os.path.join(str(root), PROGRESS_FILE)
+        self.every_secs = float(every_secs)
+        self.meta = dict(meta or {})
+        self._clock: Optional[float] = None
+        self.beats = 0
+
+    def beat(self, recorder=None, status: str = "running",
+             force: bool = False, **extra) -> bool:
+        """One heartbeat (dropped unless due or ``force``).  The payload
+        samples the recorder's last step record + health snapshot —
+        host-side values already in hand, zero device work.  Returns
+        True when a write landed."""
+        now = time.monotonic()
+        if not force and self._clock is not None:
+            if now - self._clock < self.every_secs:
+                return False
+        self._clock = now
+        doc = {
+            "v": PROGRESS_V,
+            "status": str(status),
+            "ts": round(time.time(), 3),
+            "every_secs": self.every_secs,
+            **self.meta,
+        }
+        if recorder is not None:
+            step = recorder.last_step()
+            if step is not None:
+                for k in ("states", "unique", "dt", "queue", "frontier",
+                          "load_factor", "depth"):
+                    if step.get(k) is not None:
+                        doc[k] = step[k]
+                doc["steps"] = recorder.kind_count("step")
+            health = recorder.health()
+            for k in ("phase", "stalled", "stall_reason",
+                      "ewma_states_per_sec", "eta_secs", "oom_risk"):
+                if health.get(k) is not None:
+                    doc[k] = health[k]
+        doc.update({k: v for k, v in extra.items() if v is not None})
+        try:
+            from .telemetry._atomic import atomic_write_json
+
+            atomic_write_json(self.path, doc)
+        except Exception:  # noqa: BLE001 - liveness reporting must never
+            return False  # kill the run it reports on
+        self.beats += 1
+        return True
+
+
+def read_progress(run_dir: str) -> Optional[dict]:
+    """Parse ``<run_dir>/progress.json`` and attach the liveness
+    verdict: ``fresh`` (the writer beat recently), ``age_secs``, and
+    ``verdict`` — ``running`` / ``done`` / ``failed`` straight from the
+    file, or ``dead`` when a ``running`` heartbeat went stale (the
+    writer was SIGKILLed or wedged).  None when no heartbeat exists."""
+    path = os.path.join(str(run_dir), PROGRESS_FILE)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    out = dict(doc)
+    ts = doc.get("ts")
+    if isinstance(ts, (int, float)):
+        age = max(time.time() - float(ts), 0.0)
+        out["age_secs"] = round(age, 3)
+        every = float(doc.get("every_secs") or 1.0)
+        out["fresh"] = age <= max(every * STALE_FACTOR, 5.0)
+    else:
+        out["fresh"] = False
+    status = str(doc.get("status") or "running")
+    if status == "running" and not out["fresh"]:
+        out["verdict"] = "dead"
+    else:
+        out["verdict"] = status
+    return out

@@ -31,6 +31,15 @@ HTTP surface mirrors the reference server (``src/checker/explorer.rs``):
    when no registry is configured, ``unknown_run`` for an unindexed id.
    The UI's multi-run dashboard (run list, two-run diff panel,
    per-config trend sparklines) reads these.
+ - ``GET /metrics`` — Prometheus text exposition of the live metrics
+   bus (``telemetry/metrics.py``; docs/observability.md): the engine
+   families published at host syncs plus the fleet pool families.
+   Always 200; an empty exposition just means nothing published yet.
+ - ``GET /.progress`` / ``GET /.progress/{job}`` — the atomic
+   ``progress.json`` heartbeats (``checkpoint.ProgressHeartbeat``) of
+   the served root / one fleet job, with the liveness verdict attached
+   (``running`` / ``done`` / ``failed`` / ``dead``).  Serve with
+   ``progress_root=`` (defaults to the builder's autosave dir).
  - ``GET /`` — the bundled single-page UI (``ui/``; ours, not the
    reference's).
 
@@ -376,7 +385,8 @@ def _state_views(model, fingerprints: list[int]) -> Optional[list[dict]]:
     return views
 
 
-def _make_handler(model, checker, snapshot: _Snapshot, registry=None):
+def _make_handler(model, checker, snapshot: _Snapshot, registry=None,
+                  progress_root=None):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet by default
             pass
@@ -395,6 +405,73 @@ def _make_handler(model, checker, snapshot: _Snapshot, registry=None):
             path = self.path.split("?", 1)[0]
             if path == "/.status":
                 self._send_json(_status_view(model, checker, snapshot))
+                return
+            if path == "/metrics":
+                # Prometheus text exposition (docs/observability.md): the
+                # run recorder's attached bus when there is one, else the
+                # process-wide default bus (the fleet scheduler and any
+                # .telemetry(metrics=True) run publish into it).  An
+                # empty exposition is a valid scrape, not an error.
+                rec = getattr(checker, "flight_recorder", None)
+                bus = getattr(rec, "metrics_bus", None) if rec else None
+                if bus is None:
+                    from .telemetry.metrics import default_bus
+
+                    bus = default_bus()
+                self._send(
+                    200, bus.expose().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                return
+            if path == "/.progress" or path.startswith("/.progress/"):
+                # per-job live progress (checkpoint.ProgressHeartbeat):
+                # /.progress reads the root heartbeat (a fleet pool, or
+                # a standalone autosaved run); /.progress/<job> reads
+                # <root>/jobs/<job>/progress.json
+                if progress_root is None:
+                    self._send_json(
+                        _error_body(
+                            "progress_disabled",
+                            "serve with progress_root=DIR (a fleet "
+                            "root or an autosave dir) to enable the "
+                            "live-progress endpoints",
+                        ),
+                        404,
+                    )
+                    return
+                from .checkpoint import read_progress
+
+                job = path[len("/.progress"):].strip("/")
+                if not job:
+                    doc = read_progress(progress_root)
+                else:
+                    import os as _os
+
+                    if "/" in job or ".." in job:
+                        self._send_json(
+                            _error_body(
+                                "bad_job_key",
+                                "use /.progress/<job-slug> (one path "
+                                "segment)",
+                            ),
+                            404,
+                        )
+                        return
+                    doc = read_progress(
+                        _os.path.join(progress_root, "jobs", job)
+                    )
+                if doc is None:
+                    self._send_json(
+                        _error_body(
+                            "no_heartbeat",
+                            "no progress.json here yet — the run has "
+                            "not reached its first host sync, or the "
+                            "job key is unknown",
+                        ),
+                        404,
+                    )
+                    return
+                self._send_json(doc)
                 return
             if path == "/.metrics":
                 view = _metrics_view(checker)
@@ -524,6 +601,7 @@ class ExplorerServer:
         addr: str = "localhost:3000",
         strategy: str = "bfs",
         runs_dir: Optional[str] = None,
+        progress_root: Optional[str] = None,
         **spawn_kw,
     ):
         host, _, port = addr.partition(":")
@@ -552,8 +630,18 @@ class ExplorerServer:
         else:
             raise ValueError(f"unknown Explorer strategy {strategy!r}")
         self.model = builder.model
+        # live-progress root (docs/observability.md): explicit wins,
+        # else the builder's autosave dir (the heartbeat lives next to
+        # the generations); absent = /.progress answers
+        # progress_disabled
+        if progress_root is None:
+            aopts = getattr(builder, "autosave_opts", None)
+            if aopts and aopts.get("dir"):
+                progress_root = str(aopts["dir"])
+        self.progress_root = progress_root
         handler = _make_handler(
-            self.model, self.checker, self.snapshot, registry=self.registry
+            self.model, self.checker, self.snapshot,
+            registry=self.registry, progress_root=progress_root,
         )
         self.httpd = ThreadingHTTPServer((host, int(port or "3000")), handler)
         self.addr = f"{self.httpd.server_address[0]}:{self.httpd.server_address[1]}"
@@ -578,6 +666,7 @@ def serve(
     block: bool = True,
     strategy: str = "bfs",
     runs_dir: Optional[str] = None,
+    progress_root: Optional[str] = None,
     **spawn_kw,
 ):
     """Spawn a check over ``builder`` and serve the Explorer UI
@@ -588,7 +677,8 @@ def serve(
     multi-run dashboard: ``/.runs`` endpoints + run list / two-run diff /
     trend panels over the persistent run registry."""
     server = ExplorerServer(
-        builder, addr, strategy=strategy, runs_dir=runs_dir, **spawn_kw
+        builder, addr, strategy=strategy, runs_dir=runs_dir,
+        progress_root=progress_root, **spawn_kw,
     )
     if block:
         server.serve_forever()

@@ -134,6 +134,7 @@ class _Unit:
 
     def __init__(self, priority: int, seq: int):
         self._sort = (-int(priority), int(seq))
+        self._span = None  # open job span for the current episode
 
     def __lt__(self, other: "_Unit") -> bool:
         return self._sort < other._sort
@@ -213,6 +214,25 @@ class FleetScheduler:
         self._engine_compiles = 0
         self._packed_summary: list = []
         self._ran = False
+        # span-trace root (telemetry/spans.py): minted at run() — every
+        # job span (and its attempt/engine_run descendants) parents
+        # under it, so one Chrome-trace load shows the whole campaign
+        self._span_root_ctx = None
+        # pool-level heartbeat (checkpoint.ProgressHeartbeat): an atomic
+        # <root>/progress.json the status CLI tails — including after a
+        # SIGKILL of the whole fleet process
+        from ..checkpoint import ProgressHeartbeat
+
+        # an uncreatable root (e.g. a file squatting on the path) is the
+        # ledger's loud-degradation case, not a heartbeat crash — run
+        # without the pool heartbeat and let the ledger report it
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            self._pool_hb = ProgressHeartbeat(
+                self.root, meta={"engine": "fleet", "pid": os.getpid()},
+            )
+        except OSError:
+            self._pool_hb = None
 
     # -- plumbing ------------------------------------------------------------
 
@@ -244,9 +264,12 @@ class FleetScheduler:
         with self._cv:
             return bool(self._heap)
 
-    def _publish(self) -> None:
+    def _publish(self, final: bool = False) -> None:
         """The live pool/queue snapshot behind ``/.metrics``'s fleet
-        block and the Explorer's pool panel."""
+        block and the Explorer's pool panel.  Also publishes the fleet
+        metric families (the recorder's ``set_fleet`` hook) and beats
+        the pool heartbeat (throttled; ``final`` forces a terminal
+        write)."""
         with self._cv:
             snap = {
                 "v": FLEET_V,
@@ -261,6 +284,35 @@ class FleetScheduler:
                 "preemptions": int(self._preemptions),
             }
         self.rec.set_fleet(snap)
+        if self._pool_hb is None:
+            return
+        self._pool_hb.beat(
+            None,
+            status="done" if final else "running",
+            force=final,
+            slots=snap["slots"],
+            jobs=snap["jobs"],
+            running=len(snap["running"]),
+            queued=len(snap["queued"]),
+            completed=snap["completed"],
+            preemptions=snap["preemptions"],
+        )
+
+    def _count_admission(self, decision: str) -> None:
+        """One admission-outcome tick on the fleet metrics bus (when one
+        is attached to the fleet recorder); decisions are a tiny closed
+        vocabulary, so the label stays under the cardinality cap."""
+        bus = getattr(self.rec, "metrics_bus", None)
+        if bus is None:
+            return
+        try:
+            from ..telemetry.metrics import fleet_families
+
+            fleet_families(bus)["admissions"].inc(
+                1, decision=str(decision)
+            )
+        except Exception:  # noqa: BLE001 - metrics never crash the pool
+            pass
 
     # -- admission (place) ---------------------------------------------------
 
@@ -389,6 +441,12 @@ class FleetScheduler:
             )
         self._ran = True
         t0 = time.monotonic()
+        from ..telemetry.spans import start_span
+
+        # the trace root: one fleet campaign = one trace; every job /
+        # attempt / engine_run span below parents into it
+        fleet_span = start_span("fleet")
+        self._span_root_ctx = fleet_span.ctx
         self.rec.record(
             "fleet", v=FLEET_V, event="start",
             slots=int(self.spec.slots), jobs=len(self.spec.jobs),
@@ -397,6 +455,7 @@ class FleetScheduler:
         for job in self.spec.jobs:
             self._record_job(job.key, "submit", priority=job.priority)
             decision, reason, _builder = self._admit(job)
+            self._count_admission(decision)
             if decision == REFUSED:
                 self._say(f"job {job.key!r} refused: {reason}")
                 self._results[job.key] = JobResult(
@@ -452,7 +511,11 @@ class FleetScheduler:
             engine_compiles=int(self._engine_compiles),
             packed=len(self._packed_summary),
         )
-        self._publish()
+        fleet_span.end(
+            self.rec, jobs=len(self.spec.jobs),
+            slots=int(self.spec.slots),
+        )
+        self._publish(final=True)
         return FleetResult(
             results=ordered, slots=int(self.spec.slots), secs=secs,
             packed=list(self._packed_summary),
@@ -475,12 +538,20 @@ class FleetScheduler:
                 unit = heapq.heappop(self._heap)
                 self._running[slot] = unit.label
             self._publish()
+            # one job span per SCHEDULING EPISODE on a slot: a
+            # preempted job re-queues and gets a fresh span next time —
+            # the trace shows each residency separately, gaps included
+            from ..telemetry.spans import start_span
+
+            unit._span = start_span("job", parent=self._span_root_ctx)
             try:
                 if isinstance(unit, _Packed):
                     self._run_packed(unit, slot)
                 else:
                     self._run_singleton(unit, slot)
             finally:
+                unit._span.end(self.rec, key=unit.label, slot=slot)
+                unit._span = None
                 with self._cv:
                     self._running.pop(slot, None)
                     self._cv.notify_all()
@@ -501,6 +572,10 @@ class FleetScheduler:
                 gen=latest_gen_number(job_dir),
             )
         builder = job.build()
+        if unit._span is not None:
+            # the supervisor's attempt spans (and through them the
+            # engine_run spans) parent under this episode's job span
+            builder._span_ctx = unit._span.ctx
         from ..parallel.tensor_model import twin_or_none
 
         if twin_or_none(builder.model) is None \
@@ -709,6 +784,8 @@ class FleetScheduler:
         t0 = time.monotonic()
         try:
             builder = jobs[0].build()
+            if unit._span is not None:
+                builder._span_ctx = unit._span.ctx
             if builder.telemetry_opts is None:
                 builder.telemetry()
             insts = []

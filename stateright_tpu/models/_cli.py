@@ -1807,6 +1807,62 @@ def fleet_audit(names: Optional[list] = None, stream=None) -> int:
     return 0 if ok else 1
 
 
+def fleet_status(argv: Optional[list] = None, stream=None) -> int:
+    """``status RUN_DIR``: tail the progress heartbeat of a headless run.
+
+    Reads the atomic ``progress.json`` the engines (and the fleet
+    scheduler) write next to autosave generations, plus any per-job
+    heartbeats under ``RUN_DIR/jobs/*/``.  Works post-mortem: a SIGKILLed
+    run leaves its last heartbeat behind, and a stale ``running`` status
+    is reported as ``DEAD`` (where did it stall).  Exit 0 iff at least
+    one heartbeat was found.
+    """
+    from ..checkpoint import read_progress
+
+    stream = stream or sys.stdout
+    argv = argv or []
+    if not argv:
+        print("usage: status RUN_DIR", file=stream)
+        return 1
+    root = argv[0]
+
+    def _render(tag: str, doc: dict) -> None:
+        verdict = doc.get("verdict", "?")
+        bits = [f"--- {tag}: {verdict.upper()}"]
+        if doc.get("age_secs") is not None:
+            bits.append(f"age={doc['age_secs']:.1f}s")
+        for k in ("states", "unique", "steps", "frontier", "queue",
+                  "depth", "phase", "ewma_states_per_sec", "eta_secs",
+                  "jobs", "running", "queued", "completed", "preemptions"):
+            v = doc.get(k)
+            if v is None:
+                continue
+            if isinstance(v, list):
+                v = len(v)
+            bits.append(f"{k}={v}")
+        if doc.get("stalled"):
+            bits.append(f"STALLED({doc.get('stall_reason') or '?'})")
+        print("  ".join(bits), file=stream)
+
+    found = 0
+    top = read_progress(root)
+    if top is not None:
+        _render(root, top)
+        found += 1
+    jobs_dir = os.path.join(root, "jobs")
+    if os.path.isdir(jobs_dir):
+        for name in sorted(os.listdir(jobs_dir)):
+            doc = read_progress(os.path.join(jobs_dir, name))
+            if doc is not None:
+                _render(f"jobs/{name}", doc)
+                found += 1
+    if not found:
+        print(f"status: no progress.json under {root} (run without "
+              "autosave, or not started yet)", file=stream)
+        return 1
+    return 0
+
+
 def main(argv: Optional[list] = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "audit":
@@ -1831,6 +1887,8 @@ def main(argv: Optional[list] = None) -> None:
         raise SystemExit(fleet_schedule(argv[1:]))
     if argv and argv[0] == "campaign":
         raise SystemExit(fleet_campaign(argv[1:]))
+    if argv and argv[0] == "status":
+        raise SystemExit(fleet_status(argv[1:]))
     print("USAGE:")
     print("  python -m stateright_tpu.models._cli audit [MODULE...]")
     print("    static preflight audit over the example fleet "
@@ -1878,6 +1936,10 @@ def main(argv: Optional[list] = None) -> None:
     print("    parameter-grid campaign over the fleet scheduler; "
           "writes the ROOT/campaign.json ledger with per-job "
           "wall-clock + aggregate states/s (docs/fleet.md)")
+    print("  python -m stateright_tpu.models._cli status RUN_DIR")
+    print("    tail the progress.json heartbeat of a headless run "
+          "(works post-mortem on a SIGKILLed run; stale running "
+          "heartbeats report DEAD) (docs/observability.md)")
 
 
 if __name__ == "__main__":

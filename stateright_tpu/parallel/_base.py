@@ -9,6 +9,7 @@ that is engine-independent so semantics fixes land once.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 from typing import Optional
@@ -242,6 +243,29 @@ class WavefrontChecker(Checker):
                 aopts["dir"], aopts["every_secs"], aopts["keep"],
                 recorder=self.flight_recorder,
             )
+        # span-trace context (telemetry/spans.py): the fleet scheduler /
+        # supervisor parents the engine_run span under the job/attempt
+        # span via builder._span_ctx; None roots a fresh trace.  The run
+        # span's own ctx (set by _run_traced) parents the host-seam
+        # spans (autosave / spill_drain / resharding).
+        self._span_parent = getattr(options, "_span_ctx", None)
+        self._run_span_ctx = None
+        # live progress heartbeat (checkpoint.ProgressHeartbeat,
+        # docs/observability.md): an atomic progress.json next to the
+        # autosave generations, beaten at host syncs the engine already
+        # makes — `_cli status <run_dir>` tails it, SIGKILL included
+        self._heartbeat = None
+        if aopts is not None:
+            from ..checkpoint import ProgressHeartbeat
+
+            self._heartbeat = ProgressHeartbeat(
+                aopts["dir"],
+                meta={
+                    "engine": tag,
+                    "model": type(self.model).__name__,
+                    "pid": os.getpid(),
+                },
+            )
         self._autosave_config = None  # build_config cache (per checker)
         self._refresh_durability()
         # HBM memory ledger (telemetry/memory.py): per-buffer analytic
@@ -328,7 +352,7 @@ class WavefrontChecker(Checker):
         self._pre_run_validate()
         self._run_error: Optional[BaseException] = None
         if sync:
-            self._run()
+            self._run_traced()
             self._maybe_write_report()
         else:
             self._thread = threading.Thread(
@@ -342,10 +366,54 @@ class WavefrontChecker(Checker):
         surface at join()/report(), not hang the checker forever with
         ``_done`` unset and counters silently reading 0."""
         try:
-            self._run()
+            self._run_traced()
         except BaseException as e:  # noqa: BLE001 - re-raised at join()
             self._run_error = e
             self._done.set()
+
+    def _run_traced(self) -> None:
+        """The engine run inside its ``engine_run`` span plus the
+        lifecycle seams that must hold on BOTH exit paths:
+
+         - the run span closes (with ``error`` set on the exception
+           path) and unbinds from the recorder, so a crashed run's
+           Chrome trace still shows where it died;
+         - the scoped profiler stops in a ``finally`` — the engines'
+           happy-path ``stop()`` never fires when a step raises, which
+           used to leak an active ``jax.profiler`` trace into the next
+           run; ``stop()`` is idempotent and swallows backend errors,
+           so this can never double-stop or mask the original error;
+         - the heartbeat lands one forced final beat with the terminal
+           status (``done`` / ``failed``), so ``status <run_dir>``
+           distinguishes a finished run from a SIGKILLed one."""
+        from ..telemetry.spans import start_span
+
+        rec = self.flight_recorder
+        sp = None
+        if rec is not None:
+            sp = start_span("engine_run", parent=self._span_parent)
+            self._run_span_ctx = sp.ctx
+            rec.bind_span(sp.ctx.span_id)
+        error: Optional[BaseException] = None
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            error = e
+            raise
+        finally:
+            if self._profiler is not None:
+                self._profiler.stop()
+            if sp is not None:
+                sp.end(
+                    rec,
+                    engine=self._engine_tag,
+                    error=type(error).__name__ if error else None,
+                )
+                rec.bind_span(None)
+            if self._heartbeat is not None:
+                self._heartbeat.beat(
+                    rec, status="failed" if error else "done", force=True,
+                )
 
     def _deadline_stop(self) -> None:
         """The builder ``timeout()`` deadline fired: flag the cut (unless
@@ -593,15 +661,25 @@ class WavefrontChecker(Checker):
         ally so a cooperative SIGTERM loses ~zero work).  ``snap_fn`` is
         a zero-arg thunk building the engine snapshot, called only when
         a save actually happens."""
+        if self._heartbeat is not None:
+            # the live heartbeat beats at every host sync that reaches
+            # this seam (self-throttled), not only when a save is due
+            self._heartbeat.beat(self.flight_recorder)
         svc = self._autosave
         if svc is None or not (force or svc.due()):
             return
         import time as _time
 
+        from ..telemetry.spans import span as _span
+
         t0 = _time.monotonic()
         try:
-            snap = snap_fn()
-            svc.save(snap, self._autosave_manifest(snap))
+            with _span(
+                "autosave", self.flight_recorder,
+                parent=self._run_span_ctx, gen=svc._gen,
+            ):
+                snap = snap_fn()
+                svc.save(snap, self._autosave_manifest(snap))
         except Exception as e:  # noqa: BLE001 - checkpointing must never
             # kill the run it protects; OSErrors are handled (and warned
             # about) inside save(), anything else is accounted here

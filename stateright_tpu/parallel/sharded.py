@@ -59,6 +59,7 @@ from ..checker.base import CheckerBuilder
 from ..core import Expectation
 from ..ops.buckets import SLOTS, bucket_insert, window_unique
 from ..ops.hashing import EMPTY, row_hash
+from ..telemetry.spans import span as tel_span
 from ..testing import faults
 from ._base import WavefrontChecker
 from .prewarm import CompileWatch, donation_supported
@@ -1406,9 +1407,19 @@ class ShardedTpuChecker(WavefrontChecker):
                     # own addressable data (lockstep growth).
                     self.growth_events.append((status, unique))
                     t_grow = time.monotonic()
-                    cap, fcap, bf, cf, pending = self._grow_carry_lockstep(
-                        carry, cap, fcap, bf, cf, status
-                    )
+                    # host seam span: mesh-wide lockstep resharding is
+                    # the sharded engine's expensive host excursion —
+                    # the trace nests it under the engine_run span
+                    with tel_span(
+                        "resharding", rec,
+                        parent=self._run_span_ctx, cap=int(cap),
+                        unique=int(unique),
+                    ):
+                        cap, fcap, bf, cf, pending = (
+                            self._grow_carry_lockstep(
+                                carry, cap, fcap, bf, cf, status
+                            )
+                        )
                     if self._por:
                         # growth is a boundary: arm one fully expanded
                         # wavefront (replicated scalar, lockstep-safe)

@@ -74,6 +74,7 @@ from ..ops.buckets import (
     window_unique,
 )
 from ..ops.hashing import EMPTY, row_hash
+from ..telemetry.spans import span as tel_span
 from ..testing import faults
 from ._base import WavefrontChecker
 from .prewarm import CompileWatch, donation_supported
@@ -2277,9 +2278,16 @@ class TpuChecker(WavefrontChecker):
                 and status in (_STATUS_OK, _STATUS_SPILL_SYNC)
             ):
                 t_sp = time.monotonic()
-                cap, qcap, carry = self._resolve_pending(
-                    carry, cap, qcap, batch, cand
-                )
+                # host seam span: the Bloom-deferral drain is where a
+                # spilled run's wall time hides — the trace shows it as
+                # a child of the engine_run span (telemetry/spans.py)
+                with tel_span(
+                    "spill_drain", rec,
+                    parent=self._run_span_ctx, pending=int(pend_live),
+                ):
+                    cap, qcap, carry = self._resolve_pending(
+                        carry, cap, qcap, batch, cand
+                    )
                 self._stage("spill", time.monotonic() - t_sp)
                 stats = None
                 continue

@@ -236,6 +236,16 @@ def supervise(
             # restart count
             builder._supervise_restarts = restarts
             builder._supervise_degradations = list(degradations)
+            # one attempt span per supervised spawn+join
+            # (telemetry/spans.py): parents under the fleet job span when
+            # the scheduler set builder._span_ctx, roots otherwise; the
+            # engine's engine_run span parents under THIS attempt
+            from .telemetry.spans import start_span
+
+            prior_span_ctx = getattr(builder, "_span_ctx", None)
+            att_span = start_span("attempt", parent=prior_span_ctx)
+            builder._span_ctx = att_span.ctx
+            checker = None
             try:
                 checker = spawn(builder, resume=snap, **spawn_kw)
                 rec = getattr(checker, "flight_recorder", None)
@@ -252,7 +262,12 @@ def supervise(
                 if yield_event is not None:
                     _arm_yield_watch(checker, yield_event)
                 checker.join()
+                att_span.end(rec, attempt=restarts)
             except BaseException as e:  # noqa: BLE001 - classified below
+                att_span.end(
+                    getattr(checker, "flight_recorder", None),
+                    attempt=restarts, error=type(e).__name__,
+                )
                 cls = classify_failure(e)
                 att = Attempt(
                     n=len(attempts), outcome=cls,
@@ -293,6 +308,10 @@ def supervise(
                 )
                 sleep(delay)
                 continue
+            finally:
+                # each attempt's span ctx must not leak into the next
+                # attempt (or outlive supervision on the builder)
+                builder._span_ctx = prior_span_ctx
             yielded = yield_event is not None and yield_event.is_set()
             attempts.append(Attempt(
                 n=len(attempts),

@@ -492,6 +492,7 @@ class SweepChecker(Checker):
         self._cartography = bool(self._telemetry_opts.get("cartography"))
         self._report_path = getattr(options, "report_path", None)
         self._run_dir = getattr(options, "run_dir", None)
+        self._span_parent = getattr(options, "_span_ctx", None)
         self.flight_recorder = options._make_recorder("sweep")
         self.cohorts = build_cohorts(spec)
         self.results: dict = {}
@@ -705,11 +706,31 @@ class SweepChecker(Checker):
     # -- run loop ------------------------------------------------------------
 
     def _run_guarded(self) -> None:
+        from ..telemetry.spans import start_span
+
+        rec = self.flight_recorder
+        sp = None
+        if rec is not None:
+            # engine_run span (telemetry/spans.py): parents under the
+            # job/attempt span when the fleet/supervisor set
+            # builder._span_ctx; roots a fresh trace otherwise
+            sp = start_span("engine_run", parent=self._span_parent)
+            rec.bind_span(sp.ctx.span_id)
         try:
             self._run()
         except BaseException as e:  # noqa: BLE001 - re-raised at join()
             self._run_error = e
         finally:
+            if sp is not None:
+                sp.end(
+                    rec,
+                    engine="sweep",
+                    error=(
+                        type(self._run_error).__name__
+                        if self._run_error else None
+                    ),
+                )
+                rec.bind_span(None)
             self._done.set()
 
     def _restore_done(self, snap: dict) -> None:

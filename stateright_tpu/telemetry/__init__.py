@@ -29,6 +29,14 @@ Pieces:
    (IDENTICAL / ISOMORPHIC / PERF-ONLY / DIVERGENT) behind the
    ``compare`` CLI verb, ``regress.py --diff``, and the Explorer's
    multi-run dashboard (docs/telemetry.md "Comparing runs").
+ - ``spans.py`` — span-structured tracing (fleet job → supervisor
+   attempt → engine run → step blocks → host seams); span records ride
+   the ring and export as nested Chrome duration events
+   (docs/observability.md).
+ - :class:`MetricsBus` (``metrics.py``) — the live typed-metrics bus
+   (counters/gauges/histograms with labeled families) behind the
+   Explorer's Prometheus ``GET /metrics``; attach with
+   ``.telemetry(metrics=True)`` or ``STATERIGHT_TPU_METRICS=1``.
 
 Enabled per run via ``model.checker().telemetry()``; the recorder then
 hangs off the checker as ``checker.flight_recorder``.  **Overhead
@@ -44,11 +52,20 @@ from .recorder import FlightRecorder, STATUS_NAMES
 from .profile import ScopedProfiler
 from .health import HealthTracker
 from .registry import RunRegistry
+from .spans import SpanContext, SpanHandle, span, start_span
+from .metrics import MetricsBus, default_bus, reset_default_bus
 
 __all__ = [
     "FlightRecorder",
     "HealthTracker",
+    "MetricsBus",
     "RunRegistry",
     "ScopedProfiler",
+    "SpanContext",
+    "SpanHandle",
     "STATUS_NAMES",
+    "default_bus",
+    "reset_default_bus",
+    "span",
+    "start_span",
 ]

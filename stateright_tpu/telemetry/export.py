@@ -41,6 +41,10 @@ def to_jsonl(rec: FlightRecorder, path, append: bool = False) -> None:
         "capacity": rec.capacity,
         "summary": rec.summary(),
         "counters": rec.counters(),
+        # clock origin (monotonic): lets a merged multi-run export
+        # (fleet scheduler + per-job recorders appended to one file)
+        # re-align every run's relative ``t`` onto one shared timeline
+        "t0": round(rec.t0_monotonic, 6),
     }
     with open(path, "a" if append else "w") as f:
         f.write(json.dumps(header) + "\n")
@@ -59,6 +63,12 @@ def from_jsonl(path) -> FlightRecorder:
     design."""
     rec = None
     headers = []
+    # multi-run alignment: later runs' relative timestamps shift by the
+    # difference of their monotonic clock origins against the FIRST
+    # run's (headers carry ``t0``; absent — an older export — the shift
+    # is zero, the pre-alignment behavior)
+    t0_first = None
+    t_shift = 0.0
 
     def replaying(r):
         # exported health events replay verbatim; replayed steps must not
@@ -74,7 +84,10 @@ def from_jsonl(path) -> FlightRecorder:
             obj = json.loads(line)
             if obj.get("kind") == "header":
                 headers.append(obj)
+                h_t0 = obj.get("t0")
                 if rec is None:
+                    if isinstance(h_t0, (int, float)):
+                        t0_first = float(h_t0)
                     rec = replaying(FlightRecorder(
                         capacity=int(obj.get("capacity", 4096)),
                         meta=obj.get("meta") or {},
@@ -86,6 +99,11 @@ def from_jsonl(path) -> FlightRecorder:
                     # delta baseline so they are not clamped/diffed
                     # against the previous run's totals
                     rec._reset_step_baseline()
+                    if (
+                        t0_first is not None
+                        and isinstance(h_t0, (int, float))
+                    ):
+                        t_shift = float(h_t0) - t0_first
                 for k, v in (obj.get("counters") or {}).items():
                     rec.add(k, v)
                 continue
@@ -95,10 +113,13 @@ def from_jsonl(path) -> FlightRecorder:
             fields = {
                 k: v for k, v in obj.items() if k not in ("seq", "t", "kind")
             }
+            t_in = obj.get("t")
+            if t_in is not None and t_shift:
+                t_in = round(float(t_in) + t_shift, 6)
             if kind == "step":
-                stored = rec.step(t=obj.get("t"), **fields)
+                stored = rec.step(t=t_in, **fields)
             else:
-                stored = rec.record(kind, t=obj.get("t"), **fields)
+                stored = rec.record(kind, t=t_in, **fields)
             if "seq" in obj:
                 # keep the original sequence numbers (replay renumbers
                 # from 1, which would mislabel a ring that had evicted)
@@ -111,10 +132,47 @@ def from_jsonl(path) -> FlightRecorder:
     return rec
 
 
+def _span_lanes(records: list) -> tuple:
+    """Lane (``tid``) assignment for span-structured records: every span
+    renders on the lane of its ROOT ancestor, so one fleet job and all
+    its descendants (supervisor attempts, engine runs, step blocks, host
+    seams) share a track and the viewer nests them by time containment —
+    while concurrent sibling jobs land on separate tracks and never
+    corrupt each other's nesting.  Returns ``(lane_of_span_id, lanes)``
+    where lanes start at 100 (the plain step lane stays 1)."""
+    by_id = {}
+    for r in records:
+        if r["kind"] == "span" and r.get("span_id"):
+            by_id[r["span_id"]] = r
+    roots: dict = {}
+
+    def root_of(sid: str) -> str:
+        seen = set()
+        while True:
+            r = by_id.get(sid)
+            if r is None:
+                return sid
+            parent = r.get("parent_id")
+            if not parent or parent not in by_id or parent in seen:
+                return sid
+            seen.add(sid)
+            sid = parent
+
+    lane_of: dict = {}
+    for sid in by_id:
+        root = root_of(sid)
+        if root not in roots:
+            roots[root] = 100 + len(roots)
+        lane_of[sid] = roots[root]
+    return lane_of, roots
+
+
 def to_chrome_trace(rec: FlightRecorder, path) -> None:
     events = []
     pid = 1
-    for r in rec.records():
+    all_records = rec.records()
+    span_lane, _ = _span_lanes(all_records)
+    for r in all_records:
         ts_us = r["t"] * 1e6
         args = {
             k: v for k, v in r.items() if k not in ("seq", "t", "kind")
@@ -131,7 +189,9 @@ def to_chrome_trace(rec: FlightRecorder, path) -> None:
                 "ts": round(max(ts_us - dur_us, 0.0), 3),
                 "dur": round(dur_us, 3),
                 "pid": pid,
-                "tid": 1,
+                # a step bound to an engine-run span renders on that
+                # span's lane, nesting as its child step-block
+                "tid": span_lane.get(r.get("span"), 1),
                 "args": args,
             })
             # counter track: throughput + table load, plotted by the viewer
@@ -237,6 +297,23 @@ def to_chrome_trace(rec: FlightRecorder, path) -> None:
                     "pid": pid,
                     "args": hbm,
                 })
+        elif r["kind"] == "span":
+            # span-structured tracing (telemetry/spans.py): proper
+            # nested duration events — the record's ``t`` is the close
+            # time, so the event anchors at ``t - dur``; every span in
+            # one lineage shares its root's lane, and the viewer nests
+            # by time containment
+            dur_us = max(float(r.get("dur", 0.0)) * 1e6, 1.0)
+            events.append({
+                "name": str(r.get("name", "span")),
+                "cat": "span",
+                "ph": "X",
+                "ts": round(max(ts_us - dur_us, 0.0), 3),
+                "dur": round(dur_us, 3),
+                "pid": pid,
+                "tid": span_lane.get(r.get("span_id"), 100),
+                "args": args,
+            })
         else:
             events.append({
                 "name": r["kind"],

@@ -37,6 +37,17 @@ class ScopedProfiler:
         self._active = False
         self._failed = False
 
+    def _record(self, **fields) -> None:
+        if self.recorder is None:
+            return
+        # profile events carry the engine-run span id when one is bound,
+        # so the Chrome trace nests the profiled window under the run
+        # span it actually traced (telemetry/spans.py)
+        sid = self.recorder.bound_span()
+        if sid is not None:
+            fields.setdefault("span", sid)
+        self.recorder.record("profile", **fields)
+
     def maybe_start(self) -> None:
         if self._active or self._failed or self.steps <= 0:
             return
@@ -46,16 +57,14 @@ class ScopedProfiler:
             os.makedirs(self.logdir, exist_ok=True)
             jax.profiler.start_trace(self.logdir)
             self._active = True
-            if self.recorder is not None:
-                self.recorder.record(
-                    "profile", event="start", logdir=self.logdir,
-                    steps=self.steps,
-                )
+            self._record(
+                event="start", logdir=self.logdir, steps=self.steps,
+            )
         except Exception as e:  # noqa: BLE001 - profiling never breaks a run
             self._failed = True
             if self.recorder is not None:
-                self.recorder.record(
-                    "profile", event="unavailable",
+                self._record(
+                    event="unavailable",
                     error=f"{type(e).__name__}: {e}",
                 )
 
@@ -68,6 +77,13 @@ class ScopedProfiler:
             self.stop()
 
     def stop(self) -> None:
+        """Close the trace.  Idempotent — the flag flips BEFORE the
+        backend call, so the run wrapper's ``finally`` (which stops the
+        profiler on the exception path too) can race or repeat a
+        happy-path stop without double-stopping; and every backend error
+        is swallowed into a ``stop-failed`` event, so calling this while
+        an engine exception is in flight never masks the original
+        error."""
         if not self._active:
             return
         self._active = False
@@ -75,15 +91,14 @@ class ScopedProfiler:
             import jax
 
             jax.profiler.stop_trace()
-            if self.recorder is not None:
-                self.recorder.record(
-                    "profile", event="stop", logdir=self.logdir,
-                    profiled_steps=self._ticks,
-                )
+            self._record(
+                event="stop", logdir=self.logdir,
+                profiled_steps=self._ticks,
+            )
         except Exception as e:  # noqa: BLE001
             self._failed = True
             if self.recorder is not None:
-                self.recorder.record(
-                    "profile", event="stop-failed",
+                self._record(
+                    event="stop-failed",
                     error=f"{type(e).__name__}: {e}",
                 )

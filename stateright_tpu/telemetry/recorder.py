@@ -8,7 +8,7 @@ Record schema (every record):
  - ``kind`` — ``"step"`` | ``"growth"`` | ``"occupancy"`` | ``"compile"``
    | ``"profile"`` | ``"health"`` | ``"cartography"`` | ``"memory"``
    | ``"roofline"`` | ``"checkpoint"`` | ``"fault"`` | ``"restart"``
-   | ``"sweep"`` | ``"fleet"`` | ``"job"`` | ``"note"``
+   | ``"sweep"`` | ``"fleet"`` | ``"job"`` | ``"span"`` | ``"note"``
 
 ``step`` records additionally carry the engine tag and cumulative counters
 (``states``, ``unique``) plus derived per-step deltas (``d_states``,
@@ -53,11 +53,32 @@ class FlightRecorder:
     configuration).
     """
 
-    def __init__(self, capacity: int = 4096, meta: Optional[dict] = None):
+    def __init__(self, capacity: int = 4096, meta: Optional[dict] = None,
+                 metrics=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
         self.meta = dict(meta or {})
+        # live metrics bus (telemetry/metrics.py): None (the default)
+        # detaches publication entirely — step() adds nothing, the
+        # parity pin.  ``metrics=`` attaches a bus explicitly;
+        # STATERIGHT_TPU_METRICS=1 attaches the process default bus.
+        if metrics is None:
+            import os as _os
+
+            if _os.environ.get("STATERIGHT_TPU_METRICS") == "1":
+                from .metrics import default_bus
+
+                metrics = default_bus()
+        self._bus = metrics
+        self._bus_fams: Optional[dict] = None
+        self._fleet_fams: Optional[dict] = None
+        # monotone-counter baselines for fleet snapshots (set_fleet
+        # publishes deltas of cumulative pool tallies)
+        self._fleet_pub = {"completed": 0, "preemptions": 0}
+        # span-structured tracing (telemetry/spans.py): the engine binds
+        # its run span here so step/profile records carry its id
+        self._bound_span: Optional[str] = None
         self._records: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
@@ -114,6 +135,91 @@ class FlightRecorder:
         # polling injector can lose the race against a short run
         self._stall_inject: Optional[Callable[[int], Optional[str]]] = None
 
+    # -- metrics bus (telemetry/metrics.py) ----------------------------------
+
+    @property
+    def metrics_bus(self):
+        """The attached live-metrics bus, or None (publication off)."""
+        return self._bus
+
+    def _engine_fams(self) -> dict:
+        if self._bus_fams is None:
+            from .metrics import engine_families
+
+            self._bus_fams = engine_families(self._bus)
+        return self._bus_fams
+
+    def _engine_labels(self, engine: Optional[str] = None) -> dict:
+        return {
+            "engine": str(engine or self.meta.get("engine", "?")),
+            "model": str(self.meta.get("model", "?")),
+        }
+
+    def _bus_drop(self, e: BaseException) -> None:
+        """Publication must never break a run: detach the bus and leave
+        one note in the ring saying why."""
+        self._bus = None
+        self._append_unlocked("note", {
+            "what": "metrics bus detached",
+            "error": f"{type(e).__name__}: {e}",
+        })
+
+    def _publish_step_unlocked(self, rec: dict) -> None:
+        if self._bus is None:
+            return
+        try:
+            fam = self._engine_fams()
+            labels = self._engine_labels(rec.get("engine"))
+            fam["states"].inc(int(rec.get("d_states") or 0), **labels)
+            fam["unique"].inc(int(rec.get("d_unique") or 0), **labels)
+            dt = float(rec.get("dt") or 0.0)
+            if dt > 0:
+                fam["sps"].set(
+                    round((rec.get("d_states") or 0) / dt, 1), **labels
+                )
+                fam["step"].observe(dt, **labels)
+            q = rec.get("queue", rec.get("frontier"))
+            if isinstance(q, (int, float)):
+                fam["frontier"].set(q, **labels)
+            if rec.get("load_factor") is not None:
+                fam["load"].set(float(rec["load_factor"]), **labels)
+            if rec.get("dedup") is not None:
+                fam["dedup"].set(float(rec["dedup"]), **labels)
+        except Exception as e:  # noqa: BLE001 - never break the run
+            self._bus_drop(e)
+
+    def _publish_record_unlocked(self, kind: str, rec: dict) -> None:
+        """Non-step families sampled off ring records that already
+        happen: occupancy gauges off ``occupancy`` records, the mesh
+        shard-imbalance gauge off ``mesh`` records (docs/mesh.md)."""
+        if self._bus is None or kind not in ("occupancy", "mesh"):
+            return
+        try:
+            fam = self._engine_fams()
+            labels = self._engine_labels()
+            if kind == "occupancy" and rec.get("load_factor") is not None:
+                fam["occupancy"].set(float(rec["load_factor"]), **labels)
+            elif kind == "mesh":
+                imb = rec.get("imbalance") or {}
+                v = imb.get("max_over_mean", imb.get("ratio"))
+                if v is not None:
+                    fam["imbalance"].set(float(v), **labels)
+        except Exception as e:  # noqa: BLE001 - never break the run
+            self._bus_drop(e)
+
+    # -- span binding (telemetry/spans.py) -----------------------------------
+
+    def bind_span(self, span_id: Optional[str]) -> None:
+        """Bind the engine-run span: subsequent step records (and the
+        profiler's ``profile`` events) carry ``span=<id>`` so the Chrome
+        exporter can nest step blocks under the run span."""
+        with self._lock:
+            self._bound_span = span_id
+
+    def bound_span(self) -> Optional[str]:
+        with self._lock:
+            return self._bound_span
+
     # -- recording -----------------------------------------------------------
 
     def _append_unlocked(
@@ -134,7 +240,10 @@ class FlightRecorder:
     def record(self, kind: str, *, t: Optional[float] = None, **fields) -> dict:
         """Append one record; returns it (the stored dict)."""
         with self._lock:
-            return self._append_unlocked(kind, fields, t)
+            rec = self._append_unlocked(kind, fields, t)
+            if not self._replaying:
+                self._publish_record_unlocked(kind, rec)
+            return rec
 
     def step(self, *, engine: str, states: int, unique: int,
              t: Optional[float] = None, **fields) -> dict:
@@ -159,6 +268,14 @@ class FlightRecorder:
             unique = max(int(unique), prev_unique)
             d_states = states - prev_states
             d_unique = unique - prev_unique
+            if (
+                self._bound_span is not None
+                and not self._replaying
+                and "span" not in fields
+            ):
+                # the engine-run span's id: the Chrome exporter nests
+                # this step block under its lane (telemetry/spans.py)
+                fields = {**fields, "span": self._bound_span}
             self._last_step = (now, states, unique)
             self._last_step_rec = rec = self._append_unlocked(
                 "step",
@@ -185,6 +302,10 @@ class FlightRecorder:
                 # exported events come back verbatim instead.
                 for ev in self._health.update(rec):
                     self._append_unlocked("health", ev, t=now)
+                # live metrics bus: the per-sync engine families sample
+                # the SAME host-synced values this record already holds
+                # (zero extra device round-trips; telemetry/metrics.py)
+                self._publish_step_unlocked(rec)
                 if self._stall_inject is not None:
                     why = self._stall_inject(self._kind_counts["step"])
                     if why:
@@ -248,6 +369,13 @@ class FlightRecorder:
         load, deferral/resolution tallies — ``docs/spill.md``)."""
         with self._lock:
             self._spill = dict(snap)
+            if self._bus is not None and snap.get("spilled_fps") is not None:
+                try:
+                    self._engine_fams()["spilled"].set(
+                        int(snap["spilled_fps"]), **self._engine_labels()
+                    )
+                except Exception as e:  # noqa: BLE001 - never break a run
+                    self._bus_drop(e)
 
     def spill(self) -> Optional[dict]:
         """Latest spill-tier snapshot, or None when the run was spawned
@@ -306,6 +434,32 @@ class FlightRecorder:
         blocks.  ``None`` clears it."""
         with self._lock:
             self._fleet = dict(snap) if snap else None
+            if self._bus is None or not snap:
+                return
+            try:
+                if self._fleet_fams is None:
+                    # sibling telemetry module, NOT stateright_tpu.fleet
+                    # (the import-hygiene guard in tests/test_fleet.py
+                    # greps import lines for the subsystem name)
+                    from . import metrics as _metrics
+
+                    self._fleet_fams = _metrics.fleet_families(self._bus)
+                fam = self._fleet_fams
+                fam["queue"].set(len(snap.get("queued") or ()))
+                fam["busy"].set(len(snap.get("running") or ()))
+                if snap.get("slots") is not None:
+                    fam["slots"].set(int(snap["slots"]))
+                # cumulative pool tallies publish as monotone deltas
+                for key, family in (
+                    ("completed", "completed"), ("preemptions", "preemptions")
+                ):
+                    cur = int(snap.get(key) or 0)
+                    prev = self._fleet_pub[key]
+                    if cur > prev:
+                        fam[family].inc(cur - prev)
+                        self._fleet_pub[key] = cur
+            except Exception as e:  # noqa: BLE001 - never break the pool
+                self._bus_drop(e)
 
     def fleet(self) -> Optional[dict]:
         """Latest fleet pool/queue snapshot, or None when this recorder
@@ -368,6 +522,16 @@ class FlightRecorder:
 
     def _now(self) -> float:
         return time.monotonic() - self._t0
+
+    @property
+    def t0_monotonic(self) -> float:
+        """The recorder's clock origin (``time.monotonic()`` at
+        creation).  The JSONL header carries it so a MERGED multi-run
+        export (one fleet: scheduler + jobs + attempts) can re-align
+        every run's relative timestamps onto one shared timeline —
+        within a process the monotonic clock is common, so the
+        alignment is exact (telemetry/export.py)."""
+        return self._t0
 
     def rel(self, monotonic_t: float) -> float:
         """Map an absolute ``time.monotonic()`` stamp onto this recorder's

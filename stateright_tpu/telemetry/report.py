@@ -571,7 +571,11 @@ def render_markdown(report: dict, rec=None, roofline_live=None) -> str:
         for d in dur.get("degradations", []):
             lines.append(f"- degradation: `{d}`")
     timeline = report.get("health_timeline")
-    if timeline:
+    # a truncated-to-empty window (a tiny ring whose tail slots went to
+    # span/health records) still owes the reader the truncation note —
+    # hiding the whole section would present the mid-run cut as "no
+    # timeline recorded"
+    if timeline or report.get("health_timeline_truncated"):
         lines += ["", "## Health timeline (count-derived)", ""]
         if report.get("health_timeline_truncated"):
             lines.append(
@@ -580,7 +584,7 @@ def render_markdown(report: dict, rec=None, roofline_live=None) -> str:
                 "`.telemetry(capacity=...)` for the full series)"
             )
         prev = None
-        for e in timeline:
+        for e in timeline or []:
             if e["phase"] != prev:
                 lines.append(
                     f"- step {e['step']}: phase `{e['phase']}` "

@@ -284,13 +284,26 @@ function renderHealth(h) {
 
 // Pool/queue panel over the /.metrics fleet block (fleet/scheduler.py
 // publishes a pool snapshot into the recorder; null outside fleet runs).
-function renderFleet(f) {
+// The block is a point-in-time snapshot, so the pool sparklines accumulate
+// one sample per poll client-side (bounded window).
+const FLEET_WIN = 150;
+const fleetHist = { rate: [], depth: [] };
+
+function renderFleet(f, rate) {
   const sec = $("fleet");
   if (!f) {
     sec.hidden = true;
     return;
   }
   sec.hidden = false;
+  fleetHist.depth.push((f.queued || []).length);
+  fleetHist.rate.push(rate === undefined ? null : rate);
+  for (const k of ["rate", "depth"])
+    if (fleetHist[k].length > FLEET_WIN) fleetHist[k].shift();
+  const r = sparkline($("spark-fleet-rate"), fleetHist.rate, fmtRate);
+  $("fleet-rate").textContent = r === null ? "" : "· " + r;
+  const d = sparkline($("spark-fleet-queue"), fleetHist.depth, (v) => v.toFixed(0));
+  $("fleet-depth").textContent = d === null ? "" : "· " + d;
   $("fleet-summary").textContent =
     "slots=" + f.slots + "  jobs=" + f.jobs +
     "  completed=" + f.completed +
@@ -341,7 +354,10 @@ async function pollMetrics() {
     renderCartography(m.cartography);
     renderMemory(m.memory, m.health);
     renderRoofline(m.roofline);
-    renderFleet(m.fleet);
+    const rawRates = (m.series.states_per_sec || []).filter(
+      (v) => v !== null && v !== undefined && isFinite(v)
+    );
+    renderFleet(m.fleet, rawRates.length ? rawRates[rawRates.length - 1] : null);
   } catch (e) {
     /* transient; retry next poll */
   }

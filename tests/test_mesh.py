@@ -93,6 +93,9 @@ def test_mesh_parity_2pc3_counts_verdicts_traces():
     _assert_trace_parity(solo, mesh)
 
 
+# cross-engine full-space parity on a consensus model is an
+# integration sweep — the daily tier owns it (870s fast-tier budget)
+@pytest.mark.medium
 def test_mesh_parity_paxos1():
     solo = _solo_spawn(paxos_model(1, 3), capacity=1 << 15, batch=256)
     mesh = _mesh_spawn(paxos_model(1, 3), capacity=1 << 15, batch=256)

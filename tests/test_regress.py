@@ -791,3 +791,84 @@ def test_fleet_section_gates_fresh_runs_only(tmp_path, capsys):
                  "tpu_fleet": blk},
                 "--fleet", "--allow-stale")
     assert rc == 0
+
+
+def test_live_section_gates_fresh_runs_only(tmp_path, capsys):
+    """--live: the live-observability leg (docs/observability.md).
+    Flag-gated like --fleet: absence never trips; a present leg must
+    carry count parity, a bounded sampling overhead, a published bus,
+    and a terminal heartbeat."""
+    r = _load()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(BASELINE))  # pre-observability baseline
+
+    def run(doc, *flags):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(doc))
+        rc = r.main([str(p), f"--baseline={base}", *flags])
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1])
+
+    blk = {
+        "model": "paxos-3", "unique": 34914, "states": 156408,
+        "parity": "IDENTICAL", "base_sec": 4.1, "live_sec": 4.3,
+        "overhead_frac": 0.049,
+        "families": ["stateright_states_total",
+                     "stateright_unique_states_total"],
+        "heartbeat": {"verdict": "done", "status": "done",
+                      "states": 156408, "unique": 34914, "steps": 61},
+    }
+    good = {"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+            "tpu_live": blk}
+    # absence never trips (pre-observability artifacts pass untouched)
+    rc, v = run({"fresh": True,
+                 "tpu_paxos3_states_per_sec": 270000.0}, "--live")
+    assert rc == 0 and v["live"]["ok"] is True
+    assert v["live"]["present"] is False
+    assert v["live"]["baseline_present"] is False
+    # a well-formed leg passes and reports the overhead it measured
+    rc, v = run(good, "--live")
+    assert rc == 0 and v["live"]["ok"] is True
+    assert v["live"]["overhead_frac"] == 0.049
+    # a crashed leg trips
+    rc, v = run({"fresh": True, "tpu_paxos3_states_per_sec": 270000.0,
+                 "tpu_live_error": "RuntimeError: server died"}, "--live")
+    assert rc == 1 and v["live"]["ok"] is False
+    # parity drift trips — a bus that changes the run it observes
+    bad = json.loads(json.dumps(blk))
+    bad["parity"] = "DRIFT"
+    rc, v = run({**good, "tpu_live": bad}, "--live")
+    assert rc == 1 and any("parity" in p for p in v["live"]["problems"])
+    # unbounded sampling overhead trips
+    bad = json.loads(json.dumps(blk))
+    bad["overhead_frac"] = 0.8
+    rc, v = run({**good, "tpu_live": bad}, "--live")
+    assert rc == 1 and any(
+        "overhead_frac" in p for p in v["live"]["problems"]
+    )
+    # a bus that never published trips
+    bad = json.loads(json.dumps(blk))
+    bad["families"] = []
+    rc, v = run({**good, "tpu_live": bad}, "--live")
+    assert rc == 1 and any(
+        "stateright_states_total" in p for p in v["live"]["problems"]
+    )
+    # a missing terminal heartbeat trips
+    bad = json.loads(json.dumps(blk))
+    bad["heartbeat"] = {"verdict": "dead"}
+    rc, v = run({**good, "tpu_live": bad}, "--live")
+    assert rc == 1 and any(
+        "heartbeat" in p for p in v["live"]["problems"]
+    )
+    # malformed/corrupt blocks produce a verdict, not a crash
+    for garbage in ("nope", {"unique": "x"}, {"states": -5}):
+        rc, v = run({**good, "tpu_live": garbage}, "--live")
+        assert rc == 1 and v["live"]["ok"] is False
+    # stale artifacts still exit 2; --allow-stale reports without gating
+    rc, v = run({"fresh": False, "tpu_live": blk}, "--live")
+    assert rc == 2
+    rc, v = run({"fresh": False,
+                 "tpu_paxos3_states_per_sec": 266699.0,
+                 "tpu_live": blk},
+                "--live", "--allow-stale")
+    assert rc == 0

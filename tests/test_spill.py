@@ -292,6 +292,9 @@ def test_2pc5_under_budget_completes_bit_identical(monkeypatch):
     assert cart["duplicate_hits"] == c.state_count() - c.unique_state_count()
 
 
+# a budget-starved end-to-end run through the queue-offload path is
+# integration-shaped — the daily tier owns it (870s fast-tier budget)
+@pytest.mark.medium
 def test_queue_offload_under_queue_blocking_budget(monkeypatch):
     """A budget that blocks the QUEUE doubling too: the frontier's tail
     excess rides the host FIFO and refills at drain — counts still

@@ -262,6 +262,78 @@ def test_profiler_scoped_trace(tmp_path):
         assert kinds <= {"unavailable", "stop-failed"}
 
 
+def test_profiler_stop_is_idempotent(monkeypatch, tmp_path):
+    """The run wrapper's ``finally`` stops the profiler on every exit
+    path, and the engines still call ``stop()`` on their happy path —
+    the second call must be a backend no-op, not a double-stop."""
+    from stateright_tpu.telemetry.profile import ScopedProfiler
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(
+        jax.profiler, "start_trace",
+        lambda d: calls.__setitem__("start", calls["start"] + 1),
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace",
+        lambda: calls.__setitem__("stop", calls["stop"] + 1),
+    )
+    rec = FlightRecorder(capacity=64, meta={"engine": "t"})
+    p = ScopedProfiler(str(tmp_path), steps=5, recorder=rec)
+    p.maybe_start()
+    p.stop()
+    p.stop()  # the defensive second stop
+    assert calls == {"start": 1, "stop": 1}
+    events = [e["event"] for e in rec.records("profile")]
+    assert events.count("stop") == 1
+
+
+def test_profiler_stop_failure_never_masks_engine_error(
+    monkeypatch, tmp_path
+):
+    """A mid-block engine exception reaches ``stop()`` via the run
+    wrapper's ``finally``; a backend failure there must downgrade to a
+    ``stop-failed`` event, never replace the in-flight error."""
+    from stateright_tpu.telemetry.profile import ScopedProfiler
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+
+    def broken_stop():
+        raise RuntimeError("backend gone")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", broken_stop)
+    rec = FlightRecorder(capacity=64, meta={"engine": "t"})
+    p = ScopedProfiler(str(tmp_path), steps=5, recorder=rec)
+    p.maybe_start()
+    with pytest.raises(ValueError, match="engine exploded"):
+        try:
+            raise ValueError("engine exploded")  # the engine's error
+        finally:
+            p.stop()  # swallows its own failure, propagates ours
+    events = [e["event"] for e in rec.records("profile")]
+    assert "stop-failed" in events
+    # and once failed, a repeat stop stays silent (flag already down)
+    p.stop()
+    assert [e for e in rec.records("profile")
+            if e["event"] == "stop-failed"] != []
+
+
+def test_profile_events_carry_bound_span(monkeypatch, tmp_path):
+    """Profile events record the span id of the traced block, so the
+    Chrome trace nests the profiled window under the run span."""
+    from stateright_tpu.telemetry.profile import ScopedProfiler
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    rec = FlightRecorder(capacity=64, meta={"engine": "t"})
+    rec.bind_span("deadbeefcafef00d")
+    p = ScopedProfiler(str(tmp_path), steps=1, recorder=rec)
+    p.maybe_start()
+    p.tick()  # reaches steps -> self-stop
+    events = rec.records("profile")
+    assert {e["event"] for e in events} == {"start", "stop"}
+    assert all(e["span"] == "deadbeefcafef00d" for e in events)
+
+
 # -- zero-overhead contract --------------------------------------------------
 
 
@@ -280,6 +352,10 @@ def _wavefront_run_jaxpr(telemetry: bool) -> str:
     return str(jax.make_jaxpr(lambda cr: run_fn(cr))(tuple(carry)))
 
 
+# two full engine compiles for one jaxpr diff is integration-shaped —
+# the daily tier owns it; the fast tier keeps the same zero-ops pin on
+# the metrics-bus surface (tests/test_observability.py)
+@pytest.mark.medium
 def test_telemetry_disabled_adds_zero_ops_to_step_jaxpr():
     """The flight recorder reads only host-synced state: the device program
     must be bit-identical with telemetry on and off — the PR-1 double-trace

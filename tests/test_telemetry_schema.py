@@ -40,6 +40,9 @@ SCHEMA = {
             # sharded: explicit liveness for the health model's stall
             # guard (no frontier count crosses to the host there)
             "busy": bool,
+            # engine-run span binding (telemetry/spans.py): steps of a
+            # traced run carry their engine_run span id
+            "span": str,
         },
     ),
     "growth": (
@@ -67,7 +70,22 @@ SCHEMA = {
     ),
     "profile": (
         {"event": str},
-        {"logdir": str, "steps": int, "error": str, "detail": str},
+        {"logdir": str, "steps": int, "error": str, "detail": str,
+         "span": str},
+    ),
+    "span": (
+        # span-structured tracing (telemetry/spans.py,
+        # docs/observability.md): one record per closed span, written at
+        # close time (``t - dur`` is the start).  The optional set is
+        # the union of per-span attrs: engine/error (engine_run,
+        # attempt), attempt ordinal, gen (autosave), pending
+        # (spill_drain), cap/unique (resharding), key/slot (fleet job),
+        # jobs/slots (fleet root)
+        {"v": int, "name": str, "trace_id": str, "span_id": str,
+         "dur": _REAL},
+        {"parent_id": str, "engine": str, "error": str, "attempt": int,
+         "gen": int, "pending": int, "cap": int, "unique": int,
+         "key": str, "slot": int, "jobs": int, "slots": int},
     ),
     "health": (
         {"v": int, "event": str},
